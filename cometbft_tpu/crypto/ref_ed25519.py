@@ -1,0 +1,227 @@
+"""Pure-Python ed25519 reference implementation (big-int, host-side).
+
+This is the trusted oracle for the TPU kernels in `cometbft_tpu.ops`: it
+generates the fixed-base tables, provides host-side signing, and backs the
+test suite. It mirrors the semantics of the reference engine's ed25519
+provider (reference: crypto/ed25519/ed25519.go:40-42,181-188 — ZIP-215
+verification via curve25519-voi), including the cofactored verification
+equation [8][s]B = [8]R + [8][k]A and ZIP-215's permissive point decoding
+(non-canonical y accepted, small-order points accepted, s strictly < L).
+
+Not constant-time; never use for production secret keys. Signing here exists
+for tests, tooling, and validator-file workflows (reference: privval/file.go)
+— the hot path (verification) runs on TPU.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Tuple
+
+# --- curve constants ---------------------------------------------------------
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+# base point: y = 4/5 (mod p), x recovered with even sign
+B_Y = (4 * pow(5, P - 2, P)) % P
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    """x from y per RFC 8032 §5.1.3; None if no square root exists."""
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    # candidate root of u/v via the (p-5)/8 trick
+    x = (u * pow(v, 3, P) * pow(u * pow(v, 7, P), (P - 5) // 8, P)) % P
+    vxx = (v * x * x) % P
+    if vxx == u:
+        pass
+    elif vxx == (-u) % P:
+        x = (x * SQRT_M1) % P
+    else:
+        return None
+    if x % 2 != sign:
+        x = (-x) % P
+    return x
+
+
+B_X = _recover_x(B_Y, 0)
+assert B_X is not None
+
+# --- group ops in extended coordinates (X:Y:Z:T), a=-1 twisted Edwards -------
+
+Point = Tuple[int, int, int, int]
+
+IDENTITY: Point = (0, 1, 1, 0)
+BASE: Point = (B_X, B_Y, 1, (B_X * B_Y) % P)
+
+_D2 = (2 * D) % P
+
+
+def pt_add(p: Point, q: Point) -> Point:
+    """Complete unified addition (add-2008-hwcd-3)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = ((y1 - x1) * (y2 - x2)) % P
+    b = ((y1 + x1) * (y2 + x2)) % P
+    c = (t1 * _D2 * t2) % P
+    d = (2 * z1 * z2) % P
+    e = b - a
+    f = d - c
+    g = d + c
+    h = b + a
+    return ((e * f) % P, (g * h) % P, (f * g) % P, (e * h) % P)
+
+
+def pt_double(p: Point) -> Point:
+    """dbl-2008-hwcd."""
+    x1, y1, z1, _ = p
+    a = (x1 * x1) % P
+    b = (y1 * y1) % P
+    c = (2 * z1 * z1) % P
+    h = (a + b) % P
+    e = (h - (x1 + y1) * (x1 + y1)) % P
+    g = (a - b) % P
+    f = (c + g) % P
+    return ((e * f) % P, (g * h) % P, (f * g) % P, (e * h) % P)
+
+
+def pt_neg(p: Point) -> Point:
+    x, y, z, t = p
+    return ((-x) % P, y, z, (-t) % P)
+
+
+def pt_mul(s: int, p: Point) -> Point:
+    q = IDENTITY
+    while s > 0:
+        if s & 1:
+            q = pt_add(q, p)
+        p = pt_double(p)
+        s >>= 1
+    return q
+
+
+def pt_eq(p: Point, q: Point) -> bool:
+    """Projective equality: X1*Z2 == X2*Z1 and Y1*Z2 == Y2*Z1."""
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return (x1 * z2 - x2 * z1) % P == 0 and (y1 * z2 - y2 * z1) % P == 0
+
+
+def pt_is_identity(p: Point) -> bool:
+    x, y, z, _ = p
+    return x % P == 0 and (y - z) % P == 0
+
+
+def pt_compress(p: Point) -> bytes:
+    x, y, z, _ = p
+    zi = pow(z, P - 2, P)
+    x, y = (x * zi) % P, (y * zi) % P
+    return ((y | ((x & 1) << 255))).to_bytes(32, "little")
+
+
+def pt_decompress(s: bytes, zip215: bool = True) -> Point | None:
+    """Decode a 32-byte point.
+
+    zip215=True (the verification default, matching the reference's
+    curve25519-voi config at crypto/ed25519/ed25519.go:181-188): the y
+    coordinate is NOT required to be canonical (values >= p are reduced),
+    and x == 0 with sign bit 1 is accepted.
+    """
+    if len(s) != 32:
+        return None
+    val = int.from_bytes(s, "little")
+    sign = (val >> 255) & 1
+    y = val & ((1 << 255) - 1)
+    if not zip215 and y >= P:
+        return None
+    y %= P
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    if not zip215 and x == 0 and sign == 1:
+        return None
+    return (x, y, 1, (x * y) % P)
+
+
+# --- scalars -----------------------------------------------------------------
+
+def sc_reduce(b: bytes) -> int:
+    return int.from_bytes(b, "little") % L
+
+
+def clamp(h: bytes) -> int:
+    a = bytearray(h[:32])
+    a[0] &= 248
+    a[31] &= 127
+    a[31] |= 64
+    return int.from_bytes(bytes(a), "little")
+
+
+# --- RFC 8032 sign / verify --------------------------------------------------
+
+def pubkey_from_seed(seed: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = clamp(h)
+    return pt_compress(pt_mul(a, BASE))
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = clamp(h)
+    prefix = h[32:]
+    pub = pt_compress(pt_mul(a, BASE))
+    r = sc_reduce(hashlib.sha512(prefix + msg).digest())
+    rb = pt_compress(pt_mul(r, BASE))
+    k = sc_reduce(hashlib.sha512(rb + pub + msg).digest())
+    s = (r + k * a) % L
+    return rb + s.to_bytes(32, "little")
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes, zip215: bool = True) -> bool:
+    """Cofactored ZIP-215 verification: [8][s]B == [8]R + [8][k]A.
+
+    Mirrors reference crypto/ed25519/ed25519.go:181-188 (VerifyOptionsZIP_215).
+    k is hashed over the ORIGINAL encodings of R and A, not re-canonicalized.
+    """
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:  # s must always be canonical (malleability check)
+        return False
+    a_pt = pt_decompress(pub, zip215=zip215)
+    r_pt = pt_decompress(sig[:32], zip215=zip215)
+    if a_pt is None or r_pt is None:
+        return False
+    k = sc_reduce(hashlib.sha512(sig[:32] + pub + msg).digest())
+    # [s]B - R - [k]A, then multiply by cofactor 8
+    acc = pt_add(pt_mul(s, BASE), pt_neg(pt_add(r_pt, pt_mul(k, a_pt))))
+    for _ in range(3):
+        acc = pt_double(acc)
+    return pt_is_identity(acc)
+
+
+# --- fixed-base window table (consumed by ops/edwards.py) --------------------
+
+def base_table_int(windows: int = 64, wbits: int = 4) -> List[List[Point]]:
+    """table[i][j] = [j * 2**(wbits*i)]B in extended coords (Z not normalized).
+
+    Built iteratively (row i+1 = each entry of row i doubled wbits times) so
+    import-time cost stays low; entries keep projective Z to avoid inversions.
+    """
+    row: List[Point] = [IDENTITY, BASE]
+    for j in range(2, 2**wbits):
+        row.append(pt_add(row[j - 1], BASE))
+    table = [row]
+    for _ in range(windows - 1):
+        prev = table[-1]
+        nxt = []
+        for pt in prev:
+            q = pt
+            for _ in range(wbits):
+                q = pt_double(q)
+            nxt.append(q)
+        table.append(nxt)
+    return table

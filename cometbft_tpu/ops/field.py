@@ -1,0 +1,264 @@
+"""GF(2^255-19) arithmetic as batched JAX ops, TPU-first.
+
+Design: a field element is 16 little-endian limbs of 16 bits stored in int32,
+shape (..., 16). All arithmetic is pure 32-bit integer VPU work — no int64
+(TPU emulates s64 as u32 pairs; we avoid it entirely):
+
+- products of 16-bit limbs are computed exactly in uint32 and immediately
+  split into lo/hi 16-bit halves, so schoolbook accumulation never exceeds
+  ~2^21 per limb (int32-safe);
+- the 32-limb product folds mod p via 2^256 ≡ 38, then fe_carry restores
+  every limb to STRICTLY [0, 2^16) — this strict bound is load-bearing: it
+  is what keeps the 16×16-bit uint32 products exact;
+- subtraction adds 4p limb-wise first so intermediates stay non-negative.
+
+Values are kept *lazily* reduced (mod p only up to the 2^256 ≡ 38 fold);
+`canonical` fully reduces for comparisons and serialization.
+
+This replaces the reference engine's CPU field arithmetic dependency
+(curve25519-voi assembly, reference crypto/ed25519/ed25519.go:10-11) with a
+vmappable formulation: every op broadcasts over arbitrary leading batch
+dimensions, which is how signatures tile across the VPU's (8,128) lanes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+NLIMBS = 16
+LIMB_BITS = 16
+MASK = (1 << LIMB_BITS) - 1
+
+P_INT = 2**255 - 19
+
+
+def limbs_from_int(x: int) -> np.ndarray:
+    """Host helper: python int -> (16,) int32 limbs."""
+    x %= 2**256
+    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)],
+                    dtype=np.int32)
+
+
+def int_from_limbs(limbs) -> int:
+    """Host helper: (16,) limbs -> python int (not reduced mod p)."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(arr[i]) << (LIMB_BITS * i) for i in range(NLIMBS))
+
+
+# p and 4p as limb constants. 4p has every limb >= 2^17 - 4 so that
+# (a + 4p - b) is non-negative limb-wise for any limbs a, b < 2^16+38.
+P_LIMBS = limbs_from_int(P_INT)
+FOUR_P_LIMBS = np.array(
+    [4 * 0xFFED] + [4 * 0xFFFF] * 14 + [4 * 0x7FFF], dtype=np.int32)
+assert int_from_limbs(FOUR_P_LIMBS) == 4 * P_INT
+
+
+def fe_const(x: int) -> jnp.ndarray:
+    return jnp.asarray(limbs_from_int(x))
+
+
+def fe_zeros(shape=()) -> jnp.ndarray:
+    return jnp.zeros((*shape, NLIMBS), dtype=jnp.int32)
+
+
+def _carry_pass(x: jnp.ndarray):
+    c = jnp.zeros_like(x[..., 0])
+    outs = []
+    for i in range(NLIMBS):
+        t = x[..., i] + c
+        outs.append(t & MASK)
+        c = t >> LIMB_BITS
+    return jnp.stack(outs, axis=-1), c
+
+
+def fe_carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Normalize to limbs STRICTLY in [0, 2^16); value reduced mod 2^256→38.
+
+    Precondition: limbs in [0, 2^27). Structure: carry pass, fold 38·carry
+    into limb 0, second pass, fold again, then a 2-limb mini-cascade. The
+    second fold can only fire when the value landed in [2^256, 2^256+2^17),
+    in which case limbs 2..15 are provably zero, so the mini-cascade fully
+    absorbs it — every limb ends < 2^16, keeping 16×16-bit uint32 products
+    in fe_mul exact.
+    """
+    x, c = _carry_pass(x)
+    x = x.at[..., 0].add(38 * c)
+    x, c = _carry_pass(x)
+    t0 = x[..., 0] + 38 * c
+    e = t0 >> LIMB_BITS
+    x = x.at[..., 0].set(t0 & MASK)
+    x = x.at[..., 1].add(e)
+    return x
+
+
+def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return fe_carry(a + b)
+
+
+def fe_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return fe_carry(a + jnp.asarray(FOUR_P_LIMBS) - b)
+
+
+def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
+    return fe_carry(jnp.asarray(FOUR_P_LIMBS) - a)
+
+
+def _mul_accumulate(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 16x16-limb product -> 32 limbs, each < ~2^21."""
+    au = a.astype(jnp.uint32)
+    bu = b.astype(jnp.uint32)
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    bu = jnp.broadcast_to(bu, (*batch, NLIMBS))
+    acc = jnp.zeros((*batch, 2 * NLIMBS), dtype=jnp.int32)
+    for i in range(NLIMBS):
+        prod = au[..., i:i + 1] * bu                      # exact in uint32
+        lo = (prod & MASK).astype(jnp.int32)
+        hi = (prod >> LIMB_BITS).astype(jnp.int32)
+        acc = acc.at[..., i:i + NLIMBS].add(lo)
+        acc = acc.at[..., i + 1:i + 1 + NLIMBS].add(hi)
+    return acc
+
+
+def _fold_mod_p(acc: jnp.ndarray) -> jnp.ndarray:
+    # fold limbs 16..31 (weights 2^(16k), k>=16) via 2^256 ≡ 38 (mod p)
+    return fe_carry(acc[..., :NLIMBS] + 38 * acc[..., NLIMBS:2 * NLIMBS])
+
+
+def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _fold_mod_p(_mul_accumulate(a, b))
+
+
+def fe_square(a: jnp.ndarray) -> jnp.ndarray:
+    """Squaring with the symmetric-term trick: 136 limb products vs 256.
+
+    Off-diagonal products a_i·a_j (i<j) are computed once and their lo/hi
+    halves added twice; per-limb accumulation stays < 2^22, int32-safe.
+    """
+    au = a.astype(jnp.uint32)
+    batch = a.shape[:-1]
+    acc = jnp.zeros((*batch, 2 * NLIMBS), dtype=jnp.int32)
+    for i in range(NLIMBS):
+        prod = au[..., i:i + 1] * au[..., i:]             # j >= i row
+        lo = (prod & MASK).astype(jnp.int32)
+        hi = (prod >> LIMB_BITS).astype(jnp.int32)
+        acc = acc.at[..., 2 * i].add(lo[..., 0])
+        acc = acc.at[..., 2 * i + 1].add(hi[..., 0])
+        n = NLIMBS - 1 - i
+        if n:
+            acc = acc.at[..., 2 * i + 1:2 * i + 1 + n].add(2 * lo[..., 1:])
+            acc = acc.at[..., 2 * i + 2:2 * i + 2 + n].add(2 * hi[..., 1:])
+    return _fold_mod_p(acc)
+
+
+def fe_mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Multiply by a small constant c < 2^10 (else a·c could exceed
+    fe_carry's 2^27 limb precondition and go silently wrong)."""
+    assert 0 <= c < (1 << 10), c
+    return fe_carry(a * c)
+
+
+def fe_select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """cond ? a : b, broadcasting cond (...,) over limbs."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """Subtract p if x >= p (x fully carried). One borrow pass decides both:
+    the final carry of (x - p) is 0 iff x >= p (arithmetic shift = floor)."""
+    diff, borrow = _carry_pass(x - jnp.asarray(P_LIMBS))
+    return fe_select(borrow == 0, diff, x)
+
+
+def fe_canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce to [0, p). Input value < 2^256 (< 2p + 38)."""
+    x = fe_carry(x)
+    x = _cond_sub_p(x)
+    x = _cond_sub_p(x)
+    return x
+
+
+def fe_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a == b (mod p) -> bool (...,)."""
+    d = fe_canonical(fe_sub(a, b))
+    return jnp.all(d == 0, axis=-1)
+
+
+def fe_is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(fe_canonical(a) == 0, axis=-1)
+
+
+def fe_parity(a: jnp.ndarray) -> jnp.ndarray:
+    """Least significant bit of the canonical representative."""
+    return fe_canonical(a)[..., 0] & 1
+
+
+def _nsquare(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return lax.fori_loop(0, n, lambda _, v: fe_square(v), x)
+
+
+def fe_pow2523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^((p-5)/8) = z^(2^252 - 3), ref10 addition chain (~254 sq + 11 mul).
+
+    Used by point decompression's combined sqrt/division trick.
+    """
+    t0 = fe_square(z)                      # z^2
+    t1 = _nsquare(t0, 2)                   # z^8
+    t1 = fe_mul(z, t1)                     # z^9
+    t0 = fe_mul(t0, t1)                    # z^11
+    t0 = fe_square(t0)                     # z^22
+    t0 = fe_mul(t1, t0)                    # z^31 = z^(2^5-1)
+    t1 = _nsquare(t0, 5)
+    t0 = fe_mul(t1, t0)                    # z^(2^10-1)
+    t1 = _nsquare(t0, 10)
+    t1 = fe_mul(t1, t0)                    # z^(2^20-1)
+    t2 = _nsquare(t1, 20)
+    t1 = fe_mul(t2, t1)                    # z^(2^40-1)
+    t1 = _nsquare(t1, 10)
+    t0 = fe_mul(t1, t0)                    # z^(2^50-1)
+    t1 = _nsquare(t0, 50)
+    t1 = fe_mul(t1, t0)                    # z^(2^100-1)
+    t2 = _nsquare(t1, 100)
+    t1 = fe_mul(t2, t1)                    # z^(2^200-1)
+    t1 = _nsquare(t1, 50)
+    t0 = fe_mul(t1, t0)                    # z^(2^250-1)
+    t0 = _nsquare(t0, 2)
+    return fe_mul(t0, z)                   # z^(2^252-3)
+
+
+def fe_invert(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(p-2), via z^(2^252-3): p-2 = 8*(2^252-3) + 3... use direct chain.
+
+    p - 2 = 2^255 - 21. Chain: t = z^(2^250-1) path shared with pow2523.
+    """
+    t0 = fe_square(z)                      # 2
+    t1 = _nsquare(t0, 2)                   # 8
+    t1 = fe_mul(z, t1)                     # 9
+    t0 = fe_mul(t0, t1)                    # 11
+    t2 = fe_square(t0)                     # 22
+    t1 = fe_mul(t1, t2)                    # 31 = 2^5-1
+    t2 = _nsquare(t1, 5)
+    t1 = fe_mul(t2, t1)                    # 2^10-1
+    t2 = _nsquare(t1, 10)
+    t2 = fe_mul(t2, t1)                    # 2^20-1
+    t3 = _nsquare(t2, 20)
+    t2 = fe_mul(t3, t2)                    # 2^40-1
+    t2 = _nsquare(t2, 10)
+    t1 = fe_mul(t2, t1)                    # 2^50-1
+    t2 = _nsquare(t1, 50)
+    t2 = fe_mul(t2, t1)                    # 2^100-1
+    t3 = _nsquare(t2, 100)
+    t2 = fe_mul(t3, t2)                    # 2^200-1
+    t2 = _nsquare(t2, 50)
+    t1 = fe_mul(t2, t1)                    # 2^250-1
+    t1 = _nsquare(t1, 5)                   # 2^255-2^5
+    return fe_mul(t1, t0)                  # 2^255-32+11 = 2^255-21 = p-2
+
+
+def fe_to_bytes_limbs(x: jnp.ndarray) -> jnp.ndarray:
+    """Canonical (..., 32) uint8 little-endian serialization."""
+    c = fe_canonical(x)
+    lo = (c & 0xFF).astype(jnp.uint8)
+    hi = ((c >> 8) & 0xFF).astype(jnp.uint8)
+    return jnp.stack([lo, hi], axis=-1).reshape(*x.shape[:-1], 32)

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX loads.
+
+Mirrors the reference's approach of testing multi-node behavior in-process
+(reference: internal/consensus/common_test.go, p2p/test_util.go) — here the
+"cluster" is a virtual 8-device mesh so sharding/collective code paths run
+without TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,119 @@
+"""Field arithmetic vs python-int oracle (reference hot path:
+crypto/ed25519/ed25519.go's curve25519-voi field ops)."""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cometbft_tpu.ops import field as fe
+
+P = fe.P_INT
+rng = random.Random(1234)
+
+
+def rand_int():
+    return rng.getrandbits(256) % (2**256)
+
+
+def to_limbs_batch(xs):
+    return jnp.asarray(np.stack([fe.limbs_from_int(x) for x in xs]))
+
+
+def from_limbs_batch(arr):
+    return [fe.int_from_limbs(np.asarray(arr)[i]) for i in range(arr.shape[0])]
+
+
+def test_roundtrip():
+    xs = [0, 1, P - 1, P, P + 1, 2**256 - 1] + [rand_int() for _ in range(20)]
+    limbs = to_limbs_batch(xs)
+    back = from_limbs_batch(limbs)
+    assert back == [x % 2**256 for x in xs]
+
+
+def test_add_sub_mul():
+    n = 64
+    a_int = [rand_int() for _ in range(n)]
+    b_int = [rand_int() for _ in range(n)]
+    a, b = to_limbs_batch(a_int), to_limbs_batch(b_int)
+
+    add_l = jax.jit(fe.fe_add)(a, b)
+    sub_l = jax.jit(fe.fe_sub)(a, b)
+    mul_l = jax.jit(fe.fe_mul)(a, b)
+    sq_l = jax.jit(fe.fe_square)(a)
+    # strict limb bound on the raw limb arrays (uint32-exactness invariant)
+    for arr in (add_l, sub_l, mul_l, sq_l):
+        raw = np.asarray(arr)
+        assert raw.min() >= 0 and raw.max() < 2**16
+
+    add, sub = from_limbs_batch(add_l), from_limbs_batch(sub_l)
+    mul, sq = from_limbs_batch(mul_l), from_limbs_batch(sq_l)
+    for i in range(n):
+        assert add[i] % P == (a_int[i] + b_int[i]) % P
+        assert sub[i] % P == (a_int[i] - b_int[i]) % P
+        assert mul[i] % P == (a_int[i] * b_int[i]) % P
+        assert sq[i] % P == (a_int[i] * a_int[i]) % P
+
+    # mixed-shape broadcast: (16,) constant against (B,16) batch, both orders
+    c3 = fe.fe_const(3)
+    m1 = np.asarray(jax.jit(fe.fe_mul)(a, c3))
+    m2 = np.asarray(jax.jit(fe.fe_mul)(c3, a))
+    assert np.array_equal(m1, m2)
+    for i in range(n):
+        assert fe.int_from_limbs(m1[i]) % P == (3 * a_int[i]) % P
+
+
+def test_limbs_strictly_16bit():
+    # adversarial: values near 2^256 where the second carry fold can fire
+    xs = [2**256 - 1, 2**256 - 19, 2**256 - 38, P, 2 * P, 2 * P + 37]
+    a = to_limbs_batch(xs)
+    out = np.asarray(jax.jit(fe.fe_carry)(a))
+    assert out.max() < 2**16
+    for i, x in enumerate(xs):
+        assert fe.int_from_limbs(out[i]) % P == x % P
+
+
+def test_canonical_eq():
+    xs = [0, 1, 19, P - 1, P, P + 5, 2 * P, 2 * P + 1, 2**256 - 1]
+    a = to_limbs_batch(xs)
+    canon = from_limbs_batch(jax.jit(fe.fe_canonical)(a))
+    assert canon == [x % P for x in xs]
+
+    b = to_limbs_batch([x + P for x in xs[:4]] + xs[4:])
+    eq = np.asarray(jax.jit(fe.fe_eq)(a, b))
+    assert eq.all()  # differ by multiples of p → equal mod p
+
+    c = to_limbs_batch([x + 1 for x in xs])
+    assert not np.asarray(jax.jit(fe.fe_eq)(a, c)).any()
+
+
+def test_neg_mul_small():
+    xs = [rand_int() for _ in range(16)]
+    a = to_limbs_batch(xs)
+    neg = from_limbs_batch(jax.jit(fe.fe_neg)(a))
+    m3 = from_limbs_batch(jax.jit(lambda v: fe.fe_mul_small(v, 486))(a))
+    for i, x in enumerate(xs):
+        assert neg[i] % P == (-x) % P
+        assert m3[i] % P == (486 * x) % P
+
+
+def test_pow2523_invert():
+    xs = [rand_int() % P for _ in range(8)]
+    a = to_limbs_batch(xs)
+    powed = from_limbs_batch(jax.jit(fe.fe_pow2523)(a))
+    inv = from_limbs_batch(jax.jit(fe.fe_invert)(a))
+    for i, x in enumerate(xs):
+        assert powed[i] % P == pow(x, (P - 5) // 8, P)
+        assert inv[i] % P == pow(x, P - 2, P)
+
+
+def test_parity_bytes():
+    xs = [rand_int() for _ in range(8)]
+    a = to_limbs_batch(xs)
+    par = np.asarray(jax.jit(fe.fe_parity)(a))
+    byts = np.asarray(jax.jit(fe.fe_to_bytes_limbs)(a))
+    for i, x in enumerate(xs):
+        assert par[i] == (x % P) & 1
+        assert bytes(byts[i]) == (x % P).to_bytes(32, "little")
