@@ -1,0 +1,182 @@
+"""Arithmetic mod the ed25519 group order L, batched JAX ops.
+
+L = 2^252 + 27742317777372353535851937790883648493 (~2^252.0).
+
+Same limb discipline as `field.py`: 16-bit little-endian limbs in int32,
+all products exact in uint32, every normalized value strictly < 2^16 per
+limb. Reduction is Barrett with b = 2^16, k = 16 limbs, which handles any
+input < 2^512 — exactly the range of a SHA-512 digest, the reference hot
+path's `k = SHA512(R||A||M) mod L` (reference: crypto/ed25519 verification
+via curve25519-voi; scalar semantics per RFC 8032 §5.1.7).
+
+Exports:
+- sc_reduce_wide: (..., 32 limbs) 512-bit -> (..., 16 limbs) mod L
+- sc_reduce:      (..., 16 limbs) 256-bit -> (..., 16 limbs) mod L
+- sc_mul / sc_mul_add: products mod L (for random-linear-combination
+  batch verification)
+- sc_lt_l: canonicality check s < L (signature malleability gate,
+  reference crypto/ed25519/ed25519.go ZIP-215 rule 1)
+- sc_nibbles: 64 radix-16 digits for windowed scalar multiplication
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .field import LIMB_BITS, MASK
+
+L_INT = 2**252 + 27742317777372353535851937790883648493
+# Barrett constant mu = floor(b^(2k) / L) = floor(2^512 / L): 17 limbs.
+MU_INT = 2**512 // L_INT
+
+
+def _limbs_const(x: int, n: int) -> np.ndarray:
+    assert 0 <= x < 2**(LIMB_BITS * n)
+    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(n)],
+                    dtype=np.int32)
+
+
+L_LIMBS = _limbs_const(L_INT, 16)
+MU_LIMBS = _limbs_const(MU_INT, 17)
+
+
+def _mp_carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Plain carry-propagation pass; final carry must be representable in
+    the last limb's headroom (callers size outputs so it is zero)."""
+    c = jnp.zeros_like(x[..., 0])
+    outs = []
+    n = x.shape[-1]
+    for i in range(n):
+        t = x[..., i] + c
+        outs.append(t & MASK)
+        c = t >> LIMB_BITS
+    return jnp.stack(outs, axis=-1)
+
+
+def _mp_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook (..., la) x (..., lb) -> (..., la+lb) normalized limbs.
+
+    Accumulation bound: min(la, lb) <= 17 rows of lo+hi 16-bit halves
+    < 17 * 2 * 2^16 < 2^22 per limb — int32-safe, same invariant as
+    field._mul_accumulate.
+    """
+    la, lb = a.shape[-1], b.shape[-1]
+    assert min(la, lb) <= 17
+    au = a.astype(jnp.uint32)
+    bu = b.astype(jnp.uint32)
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    bu = jnp.broadcast_to(bu, (*batch, lb))
+    acc = jnp.zeros((*batch, la + lb), dtype=jnp.int32)
+    for i in range(la):
+        prod = au[..., i:i + 1] * bu
+        lo = (prod & MASK).astype(jnp.int32)
+        hi = (prod >> LIMB_BITS).astype(jnp.int32)
+        acc = acc.at[..., i:i + lb].add(lo)
+        acc = acc.at[..., i + 1:i + 1 + lb].add(hi)
+    return _mp_carry(acc)
+
+
+def _mp_sub(a: jnp.ndarray, b: jnp.ndarray):
+    """(a - b) over equal-length limbs; returns (diff mod b^n, borrow) with
+    borrow 0 when a >= b else -1."""
+    c = jnp.zeros_like(a[..., 0])
+    outs = []
+    n = a.shape[-1]
+    for i in range(n):
+        t = a[..., i] - b[..., i] + c
+        outs.append(t & MASK)
+        c = t >> LIMB_BITS  # arithmetic shift: 0 or -1
+    return jnp.stack(outs, axis=-1), c
+
+
+def _cond_sub_l(r: jnp.ndarray) -> jnp.ndarray:
+    lpad = jnp.zeros(r.shape[-1], dtype=jnp.int32).at[:16].set(
+        jnp.asarray(L_LIMBS))
+    diff, borrow = _mp_sub(r, jnp.broadcast_to(lpad, r.shape))
+    return jnp.where((borrow == 0)[..., None], diff, r)
+
+
+def sc_reduce_wide(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a 512-bit value (..., 32 limbs) mod L -> (..., 16 limbs).
+
+    Barrett: q = floor(floor(x/b^15) * mu / b^17); r = x - q*L computed
+    mod b^17; r < 3L so two conditional subtractions finish.
+    """
+    assert x.shape[-1] == 32
+    q1 = x[..., 15:]                                   # 17 limbs
+    q2 = _mp_mul(q1, jnp.asarray(MU_LIMBS))            # 34 limbs
+    q3 = q2[..., 17:]                                  # 17 limbs
+    r1 = x[..., :17]                                   # x mod b^17
+    r2 = _mp_mul(q3, jnp.asarray(L_LIMBS))[..., :17]   # q3*L mod b^17
+    r, _ = _mp_sub(r1, r2)                             # exact: r < 3L < b^17
+    r = _cond_sub_l(r)
+    r = _cond_sub_l(r)
+    return r[..., :16]
+
+
+def sc_reduce(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a 256-bit value (..., 16 limbs) mod L."""
+    assert x.shape[-1] == 16
+    wide = jnp.concatenate(
+        [x, jnp.zeros_like(x)], axis=-1)
+    return sc_reduce_wide(wide)
+
+
+def sc_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a * b) mod L for reduced 16-limb scalars."""
+    return sc_reduce_wide(_mp_mul(a, b))
+
+
+def sc_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a + b) mod L for reduced scalars (sum < 2L -> one cond-subtract
+    after a 17-limb carry)."""
+    s = jnp.concatenate([a, jnp.zeros_like(a[..., :1])], axis=-1)
+    t = jnp.concatenate([b, jnp.zeros_like(b[..., :1])], axis=-1)
+    r = _mp_carry(s + t)
+    r = _cond_sub_l(r)
+    return r[..., :16]
+
+
+def sc_mul_add(a: jnp.ndarray, b: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """(a*b + c) mod L — the random-linear-combination accumulator step."""
+    return sc_add(sc_mul(a, b), c)
+
+
+def sc_lt_l(x: jnp.ndarray) -> jnp.ndarray:
+    """x < L for a 256-bit value (..., 16 limbs) -> bool (...,).
+
+    The ZIP-215 s-canonicality gate (signatures with s >= L are rejected
+    unconditionally, reference types/validation semantics)."""
+    _, borrow = _mp_sub(x, jnp.broadcast_to(jnp.asarray(L_LIMBS), x.shape))
+    return borrow != 0
+
+
+def sc_nibbles(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., 16 limbs) -> (..., 64) radix-16 digits, little-endian."""
+    shifts = jnp.arange(4, dtype=jnp.int32) * 4
+    nib = (x[..., :, None] >> shifts) & 0xF
+    return nib.reshape(*x.shape[:-1], 64)
+
+
+def sc_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., 16 limbs) -> (..., 256) bits, little-endian."""
+    shifts = jnp.arange(LIMB_BITS, dtype=jnp.int32)
+    bits = (x[..., :, None] >> shifts) & 1
+    return bits.reshape(*x.shape[:-1], 256)
+
+
+def bytes_to_limbs(b: jnp.ndarray) -> jnp.ndarray:
+    """(..., 2n) uint8 little-endian -> (..., n) 16-bit limbs."""
+    n2 = b.shape[-1]
+    assert n2 % 2 == 0
+    b32 = b.astype(jnp.int32).reshape(*b.shape[:-1], n2 // 2, 2)
+    return b32[..., 0] | (b32[..., 1] << 8)
+
+
+def limbs_to_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., n) 16-bit limbs -> (..., 2n) uint8 little-endian."""
+    lo = (x & 0xFF).astype(jnp.uint8)
+    hi = ((x >> 8) & 0xFF).astype(jnp.uint8)
+    return jnp.stack([lo, hi], axis=-1).reshape(*x.shape[:-1],
+                                                2 * x.shape[-1])

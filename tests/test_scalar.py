@@ -1,0 +1,84 @@
+"""Scalar mod-L arithmetic vs python-int oracle (reference semantics:
+RFC 8032 §5.1.7 scalar reduction as used by crypto/ed25519 batch verify)."""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import scalar as sc
+from cometbft_tpu.ops import field as fe
+
+L = sc.L_INT
+rng = random.Random(99)
+
+
+def wide_limbs(xs):
+    return jnp.asarray(np.stack([
+        np.array([(x >> (16 * i)) & 0xFFFF for i in range(32)], dtype=np.int32)
+        for x in xs]))
+
+
+def narrow_limbs(xs):
+    return jnp.asarray(np.stack([fe.limbs_from_int(x) for x in xs]))
+
+
+def from_limbs(arr):
+    return [fe.int_from_limbs(np.asarray(arr)[i]) for i in range(arr.shape[0])]
+
+
+def test_reduce_wide():
+    xs = [0, 1, L - 1, L, L + 1, 2**512 - 1, 2**256, 2**511] + \
+        [rng.getrandbits(512) for _ in range(32)]
+    out = from_limbs(jax.jit(sc.sc_reduce_wide)(wide_limbs(xs)))
+    assert out == [x % L for x in xs]
+
+
+def test_reduce_narrow():
+    xs = [0, L - 1, L, 2 * L, 2**256 - 1] + \
+        [rng.getrandbits(256) for _ in range(16)]
+    out = from_limbs(jax.jit(sc.sc_reduce)(narrow_limbs(xs)))
+    assert out == [x % L for x in xs]
+
+
+def test_mul_add():
+    a_i = [rng.getrandbits(252) % L for _ in range(16)]
+    b_i = [rng.getrandbits(252) % L for _ in range(16)]
+    c_i = [rng.getrandbits(252) % L for _ in range(16)]
+    a, b, c = narrow_limbs(a_i), narrow_limbs(b_i), narrow_limbs(c_i)
+    mul = from_limbs(jax.jit(sc.sc_mul)(a, b))
+    add = from_limbs(jax.jit(sc.sc_add)(a, b))
+    madd = from_limbs(jax.jit(sc.sc_mul_add)(a, b, c))
+    for i in range(16):
+        assert mul[i] == (a_i[i] * b_i[i]) % L
+        assert add[i] == (a_i[i] + b_i[i]) % L
+        assert madd[i] == (a_i[i] * b_i[i] + c_i[i]) % L
+
+
+def test_lt_l():
+    xs = [0, 1, L - 1, L, L + 1, 2**256 - 1, 2**255, L + 2**200]
+    out = np.asarray(jax.jit(sc.sc_lt_l)(narrow_limbs(xs)))
+    assert out.tolist() == [x < L for x in xs]
+
+
+def test_nibbles_bits():
+    xs = [rng.getrandbits(256) for _ in range(4)]
+    a = narrow_limbs(xs)
+    nibs = np.asarray(jax.jit(sc.sc_nibbles)(a))
+    bits = np.asarray(jax.jit(sc.sc_bits)(a))
+    for i, x in enumerate(xs):
+        assert sum(int(nibs[i][j]) << (4 * j) for j in range(64)) == x
+        assert sum(int(bits[i][j]) << j for j in range(256)) == x
+
+
+def test_bytes_roundtrip():
+    xs = [rng.getrandbits(256) for _ in range(4)]
+    raw = jnp.asarray(np.stack([
+        np.frombuffer(x.to_bytes(32, "little"), dtype=np.uint8)
+        for x in xs]))
+    limbs = jax.jit(sc.bytes_to_limbs)(raw)
+    assert from_limbs(limbs) == xs
+    back = np.asarray(jax.jit(sc.limbs_to_bytes)(limbs))
+    for i, x in enumerate(xs):
+        assert bytes(back[i]) == x.to_bytes(32, "little")

@@ -1,0 +1,47 @@
+"""Batched SHA-512 vs hashlib (the digest feeding k = H(R||A||M) mod L)."""
+
+import hashlib
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import sha512 as sh
+
+rng = random.Random(7)
+
+
+def _ref(m: bytes) -> bytes:
+    return hashlib.sha512(m).digest()
+
+
+def test_known_vectors():
+    msgs = [b"", b"abc", b"a" * 111, b"a" * 112, b"a" * 127, b"a" * 128,
+            b"a" * 129, bytes(range(200))]
+    blocks, nb = sh.pad_messages(msgs, max_blocks=3)
+    out = np.asarray(jax.jit(sh.sha512_blocks)(
+        jnp.asarray(blocks), jnp.asarray(nb)))
+    for i, m in enumerate(msgs):
+        assert bytes(out[i]) == _ref(m), f"mismatch for len {len(m)}"
+
+
+def test_random_batch_vote_sized():
+    # vote sign-bytes + R||A prefix: ~122+64 B, the hot-path shape
+    msgs = [bytes(rng.randrange(256) for _ in range(rng.randrange(250)))
+            for _ in range(64)]
+    blocks, nb = sh.pad_messages(msgs, max_blocks=3)
+    out = np.asarray(jax.jit(sh.sha512_blocks)(
+        jnp.asarray(blocks), jnp.asarray(nb)))
+    for i, m in enumerate(msgs):
+        assert bytes(out[i]) == _ref(m)
+
+
+def test_multi_dim_batch():
+    msgs = [b"x" * i for i in range(6)]
+    blocks, nb = sh.pad_messages(msgs, max_blocks=1)
+    b2 = jnp.asarray(blocks).reshape(2, 3, 1, 128)
+    n2 = jnp.asarray(nb).reshape(2, 3)
+    out = np.asarray(jax.jit(sh.sha512_blocks)(b2, n2)).reshape(6, 64)
+    for i, m in enumerate(msgs):
+        assert bytes(out[i]) == _ref(m)
