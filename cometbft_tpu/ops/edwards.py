@@ -1,0 +1,253 @@
+"""Batched edwards25519 group operations as JAX ops, TPU-first.
+
+A point is a tuple (X, Y, Z, T) of extended twisted-Edwards coordinates,
+each a (..., 16) int32 limb array (see `field.py`). All formulas are the
+*unified complete* ones (add-2008-hwcd-3 / dbl-2008-hwcd), valid for every
+curve point including the identity and the small-order torsion points that
+ZIP-215 decoding admits — so there is no data-dependent branching anywhere,
+which is exactly what XLA wants: one straight-line kernel, vmapped over the
+signature axis.
+
+This layer replaces the reference engine's curve backend (curve25519-voi
+assembly behind crypto/ed25519/ed25519.go:10-11) with:
+- `pt_decompress`: ZIP-215 point decoding (crypto/ed25519/ed25519.go:181-188
+  semantics — non-canonical y accepted, x=0/sign=1 accepted),
+- `straus_double_mul`: the verification workhorse s*B + k*A with shared
+  doublings (Straus/Shamir, radix-16 windows) — per-lane parallel so every
+  signature in the batch gets an independent validity verdict (required for
+  the batch-failure attribution fallback, types/validation.go:306-315).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Tuple
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from .field import (
+    NLIMBS, fe_add, fe_sub, fe_neg, fe_mul, fe_square, fe_carry,
+    fe_select, fe_eq, fe_is_zero, fe_parity, fe_pow2523, fe_canonical,
+    fe_invert, limbs_from_int, fe_to_bytes_limbs,
+)
+from .scalar import bytes_to_limbs, sc_nibbles
+from ..crypto import ref_ed25519 as ref
+
+Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
+
+D_LIMBS = limbs_from_int(ref.D)
+TWO_D_LIMBS = limbs_from_int((2 * ref.D) % ref.P)
+SQRT_M1_LIMBS = limbs_from_int(ref.SQRT_M1)
+ONE_LIMBS = limbs_from_int(1)
+
+
+def pt_identity(batch=()) -> Point:
+    z = jnp.zeros((*batch, NLIMBS), dtype=jnp.int32)
+    one = jnp.broadcast_to(jnp.asarray(ONE_LIMBS), (*batch, NLIMBS))
+    return (z, one, one, z)
+
+
+def pt_select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
+    return tuple(fe_select(cond, a, b) for a, b in zip(p, q))
+
+
+def pt_neg(p: Point) -> Point:
+    x, y, z, t = p
+    return (fe_neg(x), y, z, fe_neg(t))
+
+
+def pt_add(p: Point, q: Point) -> Point:
+    """Unified complete addition, add-2008-hwcd-3 (a=-1). 9 fe_mul."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
+    b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
+    c = fe_mul(fe_mul(t1, jnp.asarray(TWO_D_LIMBS)), t2)
+    d = fe_carry(2 * fe_mul(z1, z2))
+    e = fe_sub(b, a)
+    f = fe_sub(d, c)
+    g = fe_add(d, c)
+    h = fe_add(b, a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_double(p: Point) -> Point:
+    """dbl-2008-hwcd. 4 squarings + 4 muls (T input unused)."""
+    x1, y1, z1, _ = p
+    a = fe_square(x1)
+    b = fe_square(y1)
+    c = fe_carry(2 * fe_square(z1))
+    h = fe_add(a, b)
+    e = fe_sub(h, fe_square(fe_add(x1, y1)))
+    g = fe_sub(a, b)
+    f = fe_add(c, g)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_is_identity(p: Point) -> jnp.ndarray:
+    """Projective identity test: X == 0 and Y == Z (mod p)."""
+    x, y, z, _ = p
+    return fe_is_zero(x) & fe_eq(y, z)
+
+
+def pt_eq(p: Point, q: Point) -> jnp.ndarray:
+    """Projective equality: X1*Z2 == X2*Z1 and Y1*Z2 == Y2*Z1."""
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return (fe_eq(fe_mul(x1, z2), fe_mul(x2, z1))
+            & fe_eq(fe_mul(y1, z2), fe_mul(y2, z1)))
+
+
+def pt_compress(p: Point) -> jnp.ndarray:
+    """(..., 32) uint8 canonical encoding (host-rate path; uses fe inversion
+    via pow chain — fine batched, expensive for single points)."""
+    x, y, z, _ = p
+    zi = fe_invert(z)
+    xa, ya = fe_mul(x, zi), fe_mul(y, zi)
+    out = fe_to_bytes_limbs(ya)
+    sign = (fe_parity(xa) << 7).astype(jnp.uint8)
+    return out.at[..., 31].set(out[..., 31] | sign)
+
+
+def pt_decompress(b: jnp.ndarray, zip215: bool = True
+                  ) -> Tuple[Point, jnp.ndarray]:
+    """Decode (..., 32) uint8 -> (Point, valid mask).
+
+    ZIP-215 mode (the consensus-verification default, mirroring reference
+    crypto/ed25519/ed25519.go:181-188): y >= p is accepted (lazy limb
+    representation reduces it implicitly), x=0 with sign=1 is accepted.
+    Strict mode (zip215=False) applies RFC 8032 canonicality instead.
+    """
+    sign = (b[..., 31].astype(jnp.int32) >> 7) & 1
+    yb = b.astype(jnp.int32)
+    yb = yb.at[..., 31].set(yb[..., 31] & 0x7F)
+    y = bytes_to_limbs(yb)
+
+    yy = fe_square(y)
+    # input-derived (+0) so the constant picks up y's sharding/varying axes
+    # under shard_map
+    one = jnp.asarray(ONE_LIMBS) + (y & 0)
+    u = fe_sub(yy, one)
+    v = fe_add(fe_mul(yy, jnp.asarray(D_LIMBS)), one)
+    v3 = fe_mul(fe_square(v), v)
+    v7 = fe_mul(fe_square(v3), v)
+    x = fe_mul(fe_mul(u, v3), fe_pow2523(fe_mul(u, v7)))
+    vxx = fe_mul(v, fe_square(x))
+    ok_direct = fe_eq(vxx, u)
+    ok_twisted = fe_eq(vxx, fe_neg(u))
+    x = fe_select(ok_twisted, fe_mul(x, jnp.asarray(SQRT_M1_LIMBS)), x)
+    valid = ok_direct | ok_twisted
+    x = fe_select(fe_parity(x) != sign, fe_neg(x), x)
+
+    if not zip215:
+        y_canon = jnp.all(fe_canonical(y) == y, axis=-1)
+        neg_zero = fe_is_zero(x) & (sign == 1)
+        valid = valid & y_canon & ~neg_zero
+
+    return (x, y, one, fe_mul(x, y)), valid
+
+
+# --- window tables -----------------------------------------------------------
+
+def _affine_limbs(pt) -> np.ndarray:
+    """Oracle point -> (4, 16) int32 affine extended coords."""
+    x, y, z, _ = pt
+    zi = pow(z, ref.P - 2, ref.P)
+    xa, ya = (x * zi) % ref.P, (y * zi) % ref.P
+    return np.stack([limbs_from_int(xa), limbs_from_int(ya),
+                     limbs_from_int(1), limbs_from_int((xa * ya) % ref.P)])
+
+
+@lru_cache(maxsize=None)
+def small_base_table() -> np.ndarray:
+    """(16, 4, 16) int32: [j]B for j in 0..15, affine (Z=1). Shared across
+    all lanes by the Straus loop — one broadcastable gather per window."""
+    rows = [_affine_limbs(ref.pt_mul(j, ref.BASE)) if j else
+            np.stack([limbs_from_int(0), limbs_from_int(1),
+                      limbs_from_int(1), limbs_from_int(0)])
+            for j in range(16)]
+    return np.stack(rows).astype(np.int32)
+
+
+def _lookup_shared(table: jnp.ndarray, digit: jnp.ndarray) -> Point:
+    """table (16, 4, 16) shared, digit (...,) -> Point (..., 16)."""
+    e = jnp.take(table, digit, axis=0)  # (..., 4, 16)
+    return (e[..., 0, :], e[..., 1, :], e[..., 2, :], e[..., 3, :])
+
+
+def _lookup_per_lane(table: Point, digit: jnp.ndarray) -> Point:
+    """table coords (..., 16, NLIMBS), digit (...,) -> (..., NLIMBS)."""
+    idx = digit[..., None, None]
+    return tuple(
+        jnp.take_along_axis(c, idx, axis=-2).squeeze(-2) for c in table)
+
+
+def window_table(p: Point) -> Point:
+    """Per-lane table [j]p for j in 0..15: coords each (..., 16, NLIMBS).
+
+    15 sequential complete additions; built once per batch (or cached per
+    pubkey by the crypto layer, the TPU analog of the reference's expanded
+    pubkey LRU, crypto/ed25519/ed25519.go:44,69). The chain is a lax.scan
+    so the addition body is traced/compiled once, not 14 times.
+    """
+    def step(prev, _):
+        nxt = pt_add(prev, p)
+        return nxt, nxt
+
+    # the scan carry must match p's varying axes under shard_map, so any
+    # constant-Z point (e.g. straight from pt_decompress) is re-derived
+    # from p itself (+0)
+    zero = p[0] & 0
+    p = tuple(c + zero for c in p)
+    _, rest = lax.scan(step, p, None, length=14)  # coords (14, ..., NLIMBS)
+    one = jnp.asarray(ONE_LIMBS) + zero
+    ident = (zero, one, one, zero)
+    return tuple(
+        jnp.moveaxis(
+            jnp.concatenate([ident[i][None], p[i][None], rest[i]], axis=0),
+            0, -2)
+        for i in range(4))
+
+
+def straus_double_mul(s: jnp.ndarray, k: jnp.ndarray, a_table: Point
+                      ) -> Point:
+    """s*B + k*A with shared doublings (Straus/Shamir, radix-16).
+
+    s, k: (..., 16) reduced scalar limbs. a_table: per-lane window table of
+    A (from `window_table`). 63*4 doublings + 2 adds per window, all lanes
+    in lockstep — the per-signature-parallel formulation of the batch
+    verify hot path (reference verifyCommitBatch types/validation.go:218).
+    """
+    b_tab = jnp.asarray(small_base_table())
+    s_dig = sc_nibbles(s)  # (..., 64)
+    k_dig = sc_nibbles(k)
+
+    def body(i, acc):
+        w = 63 - i
+        acc = pt_double(pt_double(pt_double(pt_double(acc))))
+        acc = pt_add(acc, _lookup_shared(b_tab, s_dig[..., w]))
+        acc = pt_add(acc, _lookup_per_lane(a_table, k_dig[..., w]))
+        return acc
+
+    batch = s.shape[:-1]
+    acc = pt_identity(batch)
+    # first window without the leading doublings (acc is identity)
+    acc = pt_add(acc, _lookup_shared(b_tab, s_dig[..., 63]))
+    acc = pt_add(acc, _lookup_per_lane(a_table, k_dig[..., 63]))
+    return lax.fori_loop(1, 64, body, acc)
+
+
+def scalar_mul(k: jnp.ndarray, p: Point) -> Point:
+    """k*p for (..., 16) scalars and a batched point (windowed, radix-16)."""
+    tab = window_table(p)
+    dig = sc_nibbles(k)
+
+    def body(i, acc):
+        w = 63 - i
+        acc = pt_double(pt_double(pt_double(pt_double(acc))))
+        return pt_add(acc, _lookup_per_lane(tab, dig[..., w]))
+
+    acc = _lookup_per_lane(tab, dig[..., 63])
+    return lax.fori_loop(1, 64, body, acc)

@@ -105,20 +105,53 @@ def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
     return fe_carry(jnp.asarray(FOUR_P_LIMBS) - a)
 
 
-def _mul_accumulate(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook 16x16-limb product -> 32 limbs, each < ~2^21."""
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _spread_matrix(la: int, lb: int) -> np.ndarray:
+    """(2*la*lb, la+lb) f32 0/1 matrix mapping flattened lo|hi halves of the
+    outer product to their output limb: lo of a_i*b_j lands at i+j, hi at
+    i+j+1. One constant matmul replaces the schoolbook scatter loop — it is
+    both the compile-time fix (no dynamic-update-slice chains for XLA to
+    chew on) and the TPU run-time fix (the accumulation rides the MXU; all
+    values < 2^21 so f32 accumulation is exact)."""
+    m = np.zeros((2 * la * lb, la + lb), dtype=np.float32)
+    for i in range(la):
+        for j in range(lb):
+            m[i * lb + j, i + j] = 1.0
+            m[la * lb + i * lb + j, i + j + 1] = 1.0
+    return m
+
+
+def spread_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(..., la) x (..., lb) limbs -> (..., la+lb) un-carried accumulation,
+    each output limb < (la+lb)*2^16 (int32-safe for la+lb <= 34).
+
+    Outer product exact in uint32 (inputs strictly < 2^16), lo/hi 16-bit
+    halves accumulated per output limb by a single constant f32 matmul.
+    Shared by field (16x16) and scalar-mod-L (Barrett widths) muls —
+    keep the exactness bounds and precision pin in this one place."""
+    la, lb = a.shape[-1], b.shape[-1]
+    assert la + lb <= 34
     au = a.astype(jnp.uint32)
     bu = b.astype(jnp.uint32)
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    bu = jnp.broadcast_to(bu, (*batch, NLIMBS))
-    acc = jnp.zeros((*batch, 2 * NLIMBS), dtype=jnp.int32)
-    for i in range(NLIMBS):
-        prod = au[..., i:i + 1] * bu                      # exact in uint32
-        lo = (prod & MASK).astype(jnp.int32)
-        hi = (prod >> LIMB_BITS).astype(jnp.int32)
-        acc = acc.at[..., i:i + NLIMBS].add(lo)
-        acc = acc.at[..., i + 1:i + 1 + NLIMBS].add(hi)
-    return acc
+    prod = au[..., :, None] * bu[..., None, :]            # (..., la, lb)
+    lo = (prod & MASK).astype(jnp.float32)
+    hi = (prod >> LIMB_BITS).astype(jnp.float32)
+    batch = prod.shape[:-2]
+    flat = jnp.concatenate(
+        [lo.reshape(*batch, la * lb), hi.reshape(*batch, la * lb)], axis=-1)
+    # precision=highest: TPU (and this host's TPU-emulating default) rounds
+    # f32 matmul inputs to bf16 otherwise, which silently corrupts limbs.
+    acc = jnp.matmul(flat, jnp.asarray(_spread_matrix(la, lb)),
+                     precision="highest")
+    return acc.astype(jnp.int32)
+
+
+def _mul_accumulate(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """16x16-limb product -> 32 limbs, each < 2^21 (int32-safe)."""
+    return spread_mul(a, b)
 
 
 def _fold_mod_p(acc: jnp.ndarray) -> jnp.ndarray:
@@ -131,25 +164,10 @@ def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def fe_square(a: jnp.ndarray) -> jnp.ndarray:
-    """Squaring with the symmetric-term trick: 136 limb products vs 256.
-
-    Off-diagonal products a_i·a_j (i<j) are computed once and their lo/hi
-    halves added twice; per-limb accumulation stays < 2^22, int32-safe.
-    """
-    au = a.astype(jnp.uint32)
-    batch = a.shape[:-1]
-    acc = jnp.zeros((*batch, 2 * NLIMBS), dtype=jnp.int32)
-    for i in range(NLIMBS):
-        prod = au[..., i:i + 1] * au[..., i:]             # j >= i row
-        lo = (prod & MASK).astype(jnp.int32)
-        hi = (prod >> LIMB_BITS).astype(jnp.int32)
-        acc = acc.at[..., 2 * i].add(lo[..., 0])
-        acc = acc.at[..., 2 * i + 1].add(hi[..., 0])
-        n = NLIMBS - 1 - i
-        if n:
-            acc = acc.at[..., 2 * i + 1:2 * i + 1 + n].add(2 * lo[..., 1:])
-            acc = acc.at[..., 2 * i + 2:2 * i + 2 + n].add(2 * hi[..., 1:])
-    return _fold_mod_p(acc)
+    """a*a via the shared outer-product/matmul path (the symmetric-term
+    halving is not worth a second kernel shape once accumulation is a
+    matmul — the MXU does the 16x16 grid in one pass either way)."""
+    return fe_mul(a, a)
 
 
 def fe_mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:

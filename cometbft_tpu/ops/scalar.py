@@ -24,7 +24,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from .field import LIMB_BITS, MASK
+from .field import LIMB_BITS, MASK, spread_mul
 
 L_INT = 2**252 + 27742317777372353535851937790883648493
 # Barrett constant mu = floor(b^(2k) / L) = floor(2^512 / L): 17 limbs.
@@ -55,26 +55,9 @@ def _mp_carry(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _mp_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook (..., la) x (..., lb) -> (..., la+lb) normalized limbs.
-
-    Accumulation bound: min(la, lb) <= 17 rows of lo+hi 16-bit halves
-    < 17 * 2 * 2^16 < 2^22 per limb — int32-safe, same invariant as
-    field._mul_accumulate.
-    """
-    la, lb = a.shape[-1], b.shape[-1]
-    assert min(la, lb) <= 17
-    au = a.astype(jnp.uint32)
-    bu = b.astype(jnp.uint32)
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    bu = jnp.broadcast_to(bu, (*batch, lb))
-    acc = jnp.zeros((*batch, la + lb), dtype=jnp.int32)
-    for i in range(la):
-        prod = au[..., i:i + 1] * bu
-        lo = (prod & MASK).astype(jnp.int32)
-        hi = (prod >> LIMB_BITS).astype(jnp.int32)
-        acc = acc.at[..., i:i + lb].add(lo)
-        acc = acc.at[..., i + 1:i + 1 + lb].add(hi)
-    return _mp_carry(acc)
+    """(..., la) x (..., lb) -> (..., la+lb) normalized limbs, via the
+    shared exact outer-product/spread-matmul kernel (field.spread_mul)."""
+    return _mp_carry(spread_mul(a, b))
 
 
 def _mp_sub(a: jnp.ndarray, b: jnp.ndarray):

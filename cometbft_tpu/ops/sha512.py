@@ -179,8 +179,11 @@ def sha512_blocks(blocks: jnp.ndarray, nblocks: jnp.ndarray) -> jnp.ndarray:
     """
     batch = blocks.shape[:-2]
     nb = blocks.shape[-2]
-    st_hi = jnp.broadcast_to(jnp.asarray(H_HI), (*batch, 8))
-    st_lo = jnp.broadcast_to(jnp.asarray(H_LO), (*batch, 8))
+    # derive the initial state from the input (+0) so its sharding/varying
+    # axes match the loop output under shard_map's vma check
+    zero = (blocks[..., 0, 0] * 0).astype(jnp.uint32)[..., None]
+    st_hi = jnp.asarray(H_HI) + zero
+    st_lo = jnp.asarray(H_LO) + zero
 
     def body(carry, xs):
         st_hi, st_lo = carry

@@ -1,0 +1,43 @@
+"""Device-mesh construction for sharded signature verification.
+
+The reference scales by replicating the whole engine per node and threading
+per-peer goroutines (SURVEY §2.3); the TPU-native scaling axes are instead
+a 2-D `jax.sharding.Mesh`:
+
+- axis "commit": independent commits tiled across chips (the cross-block
+  tiling of BASELINE.json — blocksync catch-up verifies many commits at
+  once, internal/blocksync/reactor.go:483),
+- axis "sig": signatures within a commit spread across chips, with the
+  voting-power tally riding an ICI psum (the 2/3-majority accounting of
+  types/vote_set.go:158 / types/validation.go:218 turned into a
+  collective).
+
+Single-chip keeps the same code path with a (1, 1) mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+COMMIT_AXIS = "commit"
+SIG_AXIS = "sig"
+
+
+def make_mesh(n_devices: int | None = None,
+              sig_parallel: int | None = None) -> Mesh:
+    """Factor `n_devices` into a (commit, sig) mesh.
+
+    sig_parallel defaults to 2 when even (intra-commit sharding exercises
+    the psum path) and 1 otherwise; commit-parallel takes the rest.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if sig_parallel is None:
+        sig_parallel = 2 if n % 2 == 0 and n > 1 else 1
+    assert n % sig_parallel == 0, (n, sig_parallel)
+    import numpy as np
+    grid = np.array(devs).reshape(n // sig_parallel, sig_parallel)
+    return Mesh(grid, (COMMIT_AXIS, SIG_AXIS))

@@ -1,0 +1,72 @@
+"""Sharded commit verification: the multi-chip form of the north-star path.
+
+Data layout is a (commits, validators) grid — the cross-block tile of
+BASELINE.json. The grid shards over the 2-D mesh (commit-parallel x
+sig-parallel); every chip verifies its local tile with the single-chip
+kernel (ops/ed25519.verify_core — pure lane-parallel, no cross-lane
+communication), then the per-commit signed-voting-power tally is an ICI
+`psum` over the sig axis. This is the TPU-native re-design of
+`VerifyCommitLight`'s sequential 2/3-power accounting
+(reference types/validation.go:61,218-322): the only cross-chip traffic is
+one small reduction per commit.
+
+Voting power rides in float32 on-device (exact for powers < 2^24; the
+authoritative big-int tally lives host-side in the types layer, mirroring
+the reference's int64 accounting in types/vote_set.go).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..ops.ed25519 import verify_core
+from .mesh import COMMIT_AXIS, SIG_AXIS
+
+
+def _local_tile(pub, sig, hblocks, hnblocks, power, zip215):
+    c, v = pub.shape[:2]
+    flat = lambda x: x.reshape(c * v, *x.shape[2:])
+    ok = verify_core(flat(pub), flat(sig), flat(hblocks), flat(hnblocks),
+                     zip215=zip215).reshape(c, v)
+    local_power = jnp.where(ok, power, 0.0).sum(axis=1)
+    total = jax.lax.psum(local_power, SIG_AXIS)
+    return ok, total
+
+
+def sharded_commit_verify(mesh: Mesh, pub: jnp.ndarray, sig: jnp.ndarray,
+                          hblocks: jnp.ndarray, hnblocks: jnp.ndarray,
+                          power: jnp.ndarray, zip215: bool = True
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Verify a (C, V) grid of signatures over `mesh`.
+
+    pub (C,V,32) u8; sig (C,V,64) u8; hblocks (C,V,B,128) u8;
+    hnblocks (C,V) i32; power (C,V) f32 (0 for absent/nil votes).
+    Returns (ok (C,V) bool, signed_power (C,) f32).
+    """
+    grid = P(COMMIT_AXIS, SIG_AXIS)
+    fn = _shard_map(
+        functools.partial(_local_tile, zip215=zip215),
+        mesh=mesh,
+        in_specs=(grid, grid, grid, grid, grid),
+        out_specs=(grid, P(COMMIT_AXIS)),
+    )
+    return fn(pub, sig, hblocks, hnblocks, power)
+
+
+def make_sharded_verifier(mesh: Mesh, zip215: bool = True):
+    """jit-compiled closure over the mesh (one compile per tile shape)."""
+    @jax.jit
+    def run(pub, sig, hblocks, hnblocks, power):
+        return sharded_commit_verify(mesh, pub, sig, hblocks, hnblocks,
+                                     power, zip215=zip215)
+    return run

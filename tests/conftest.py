@@ -1,18 +1,28 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX loads.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's approach of testing multi-node behavior in-process
 (reference: internal/consensus/common_test.go, p2p/test_util.go) — here the
 "cluster" is a virtual 8-device mesh so sharding/collective code paths run
 without TPU hardware.
+
+The ambient environment pre-imports jax (PYTHONPATH sitecustomize) and
+pins JAX_PLATFORMS=axon — the real-TPU tunnel. Env vars are therefore
+latched before any conftest runs, so the override must go through
+jax.config, not os.environ.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

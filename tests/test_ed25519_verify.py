@@ -1,0 +1,113 @@
+"""End-to-end batched ed25519 verification tests.
+
+Covers RFC 8032 §7.1 test vectors, malleability (s >= L), corruption
+attribution inside a batch, and ZIP-215 permissive decoding semantics
+(reference: crypto/ed25519/ed25519.go:40-42,181-188)."""
+
+import numpy as np
+
+from cometbft_tpu.crypto import ref_ed25519 as ref
+from cometbft_tpu.ops.ed25519 import verify_batch
+
+# RFC 8032 §7.1: (seed, pub, msg, sig) hex
+RFC8032 = [
+    ("9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+     "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+     "",
+     "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+     "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"),
+    ("4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+     "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+     "72",
+     "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+     "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"),
+    ("c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+     "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+     "af82",
+     "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+     "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"),
+    ("833fe62409237b9d62ec77587520911e9a759cec1d19755b7da901b96dca3d42",
+     "ec172b93ad5e563bf4932c70e1245034c35467ef2efd4d64ebf819683467e2bf",
+     "ddaf35a193617abacc417349ae20413112e6fa4e89a97ea20a9eeee64b55d39a"
+     "2192992a274fc1a836ba3c23a3feebbd454d4423643ce80e2a9ac94fa54ca49f",
+     "dc2a4459e7369633a52b1bf277839a00201009a3efbf3ecb69bea2186c26b589"
+     "09351fc9ac90b3ecfdfbc7c66431e0303dca179c138ac17ad9bef1177331a704"),
+]
+
+
+def test_rfc8032_vectors_oracle_and_kernel():
+    pubs, msgs, sigs = [], [], []
+    for seed_h, pub_h, msg_h, sig_h in RFC8032:
+        seed, pub = bytes.fromhex(seed_h), bytes.fromhex(pub_h)
+        msg, sig = bytes.fromhex(msg_h), bytes.fromhex(sig_h)
+        assert ref.pubkey_from_seed(seed) == pub
+        assert ref.sign(seed, msg) == sig
+        assert ref.verify(pub, msg, sig)
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(sig)
+    got = verify_batch(pubs, msgs, sigs)
+    assert got.all(), got
+
+
+def test_batch_attribution_and_rejections():
+    import random
+    rng = random.Random(11)
+    pubs, msgs, sigs, expect = [], [], [], []
+    for i in range(12):
+        seed = bytes([rng.randrange(256) for _ in range(32)])
+        msg = bytes([rng.randrange(256) for _ in range(rng.randrange(1, 150))])
+        pub, sig = ref.pubkey_from_seed(seed), ref.sign(seed, msg)
+        kind = i % 4
+        if kind == 1:    # corrupt signature R
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        elif kind == 2:  # corrupt message
+            msg = msg + b"x"
+        elif kind == 3:  # malleate: s += L (would pass without the s<L gate)
+            s = int.from_bytes(sig[32:], "little") + ref.L
+            if s < 2**256:
+                sig = sig[:32] + s.to_bytes(32, "little")
+            else:  # rare; corrupt instead
+                sig = sig[:32] + bytes(32)
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(sig)
+        expect.append(ref.verify(pub, msg, sig))
+        if kind != 0:
+            assert not expect[-1]
+        else:
+            assert expect[-1]
+    got = verify_batch(pubs, msgs, sigs)
+    assert list(got) == expect
+
+
+def test_malformed_inputs():
+    seed = b"\x01" * 32
+    msg = b"hello"
+    pub, sig = ref.pubkey_from_seed(seed), ref.sign(seed, msg)
+    got = verify_batch([pub, pub[:31], pub], [msg, msg, msg],
+                       [sig[:63], sig, sig])
+    assert list(got) == [False, False, True]
+
+
+def test_zip215_small_order_and_noncanonical():
+    # identity pubkey + identity R + s=0 verifies for any msg (cofactored)
+    ident = (1).to_bytes(32, "little")
+    sig = ident + bytes(32)
+    msg = b"anything"
+    assert ref.verify(ident, msg, sig)
+    # non-canonical identity encoding y = p+1: zip215 accepts, strict rejects
+    ident_nc = (ref.P + 1).to_bytes(32, "little")
+    sig_nc = ident_nc + bytes(32)
+    assert ref.verify(ident_nc, msg, sig_nc, zip215=True)
+    assert not ref.verify(ident_nc, msg, sig_nc, zip215=False)
+
+    got = verify_batch([ident, ident_nc], [msg, msg], [sig, sig_nc])
+    assert list(got) == [True, True]
+    got = verify_batch([ident, ident_nc], [msg, msg], [sig, sig_nc],
+                       zip215=False)
+    assert list(got) == [True, False]
+
+
+def test_empty_batch():
+    assert verify_batch([], [], []).shape == (0,)

@@ -1,0 +1,125 @@
+"""Edwards group ops vs the big-int oracle (reference semantics:
+crypto/ed25519 verification backend, ZIP-215 decoding)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import ref_ed25519 as ref
+from cometbft_tpu.ops import edwards as ed
+from cometbft_tpu.ops.field import limbs_from_int, int_from_limbs
+
+# jitted wrappers: eager per-op dispatch is orders of magnitude slower than
+# one compiled kernel, and compiled is the only mode that ships anyway.
+j_add = jax.jit(ed.pt_add)
+j_double = jax.jit(ed.pt_double)
+j_neg_add_isid = jax.jit(lambda p: ed.pt_is_identity(ed.pt_add(p, ed.pt_neg(p))))
+j_is_identity = jax.jit(ed.pt_is_identity)
+j_decompress = jax.jit(ed.pt_decompress, static_argnames=("zip215",))
+j_compress = jax.jit(ed.pt_compress)
+j_scalar_mul = jax.jit(ed.scalar_mul)
+j_window_table = jax.jit(ed.window_table)
+j_straus = jax.jit(ed.straus_double_mul)
+
+import random
+
+RNG = random.Random(7)
+
+
+def rand_scalar():
+    return RNG.randrange(1, ref.L)
+
+
+def rand_points(n):
+    """n random curve points (as oracle extended tuples)."""
+    return [ref.pt_mul(rand_scalar(), ref.BASE) for _ in range(n)]
+
+
+def to_limbs(pts):
+    """oracle points -> batched Point of (n, 16) limb arrays."""
+    arrs = [[], [], [], []]
+    for p in pts:
+        for i, c in enumerate(p):
+            arrs[i].append(limbs_from_int(c % ref.P))
+    return tuple(jnp.asarray(np.stack(a)) for a in arrs)
+
+
+def assert_pt_eq(jp, oracle_pts):
+    x, y, z, t = [np.asarray(c) for c in jp]
+    for i, op in enumerate(oracle_pts):
+        got = (int_from_limbs(x[i]), int_from_limbs(y[i]),
+               int_from_limbs(z[i]), int_from_limbs(t[i]))
+        assert ref.pt_eq(got, op), f"point {i} mismatch"
+        # extended-coordinate invariant T = XY/Z
+        gx, gy, gz, gt = [v % ref.P for v in got]
+        assert (gx * gy - gt * gz) % ref.P == 0, f"T invariant broken at {i}"
+
+
+def test_add_double_batch():
+    ps, qs = rand_points(8), rand_points(8)
+    jp, jq = to_limbs(ps), to_limbs(qs)
+    assert_pt_eq(j_add(jp, jq), [ref.pt_add(p, q) for p, q in zip(ps, qs)])
+    assert_pt_eq(j_double(jp), [ref.pt_double(p) for p in ps])
+
+
+def test_add_identity_and_inverse():
+    ps = rand_points(4)
+    jp = to_limbs(ps)
+    ident = ed.pt_identity((4,))
+    assert_pt_eq(j_add(jp, ident), ps)
+    assert bool(jnp.all(j_neg_add_isid(jp)))
+    assert not bool(jnp.any(j_is_identity(jp)))
+
+
+def test_decompress_roundtrip():
+    ps = rand_points(8)
+    enc = np.stack([np.frombuffer(ref.pt_compress(p), dtype=np.uint8)
+                    for p in ps])
+    pt, ok = j_decompress(jnp.asarray(enc))
+    assert bool(jnp.all(ok))
+    assert_pt_eq(pt, ps)
+    # compress back
+    out = np.asarray(j_compress(pt))
+    assert out.tobytes() == enc.tobytes()
+
+
+def test_decompress_invalid_and_zip215():
+    # y with no valid x: find one by scanning
+    bad = None
+    for y in range(2, 50):
+        if ref.pt_decompress(y.to_bytes(32, "little")) is None:
+            bad = y.to_bytes(32, "little")
+            break
+    assert bad is not None
+    # non-canonical y = p + 3 (allowed only under zip215), provided y=3 valid
+    assert ref.pt_decompress((3).to_bytes(32, "little")) is not None
+    noncanon = (ref.P + 3).to_bytes(32, "little")
+    enc = np.stack([np.frombuffer(b, dtype=np.uint8)
+                    for b in (bad, noncanon)])
+    _, ok = j_decompress(jnp.asarray(enc), zip215=True)
+    assert list(np.asarray(ok)) == [False, True]
+    _, ok = j_decompress(jnp.asarray(enc), zip215=False)
+    assert list(np.asarray(ok)) == [False, False]
+
+
+def test_window_table_and_scalar_mul():
+    ps = rand_points(3)
+    jp = to_limbs(ps)
+    ks = [rand_scalar() for _ in range(3)]
+    klimbs = jnp.asarray(np.stack([limbs_from_int(k)[:16] for k in ks]))
+    got = j_scalar_mul(klimbs, jp)
+    assert_pt_eq(got, [ref.pt_mul(k, p) for k, p in zip(ks, ps)])
+
+
+def test_straus_double_mul():
+    ps = rand_points(4)
+    jp = to_limbs(ps)
+    ss = [rand_scalar() for _ in range(4)]
+    ks = [rand_scalar() for _ in range(4)]
+    sl = jnp.asarray(np.stack([limbs_from_int(s)[:16] for s in ss]))
+    kl = jnp.asarray(np.stack([limbs_from_int(k)[:16] for k in ks]))
+    tab = j_window_table(jp)
+    got = j_straus(sl, kl, tab)
+    want = [ref.pt_add(ref.pt_mul(s, ref.BASE), ref.pt_mul(k, p))
+            for s, k, p in zip(ss, ks, ps)]
+    assert_pt_eq(got, want)
