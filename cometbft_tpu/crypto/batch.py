@@ -1,0 +1,22 @@
+"""Batch-verifier dispatch by key type — the plugin seam where the TPU
+data plane slots into every verification call site (reference
+crypto/batch/batch.go:11-35)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .keys import BatchVerifier, Ed25519BatchVerifier, PubKey, ED25519_KEY_TYPE
+
+
+def create_batch_verifier(pk: PubKey) -> Tuple[Optional[BatchVerifier], bool]:
+    """(verifier, supported) for the given key type
+    (reference crypto/batch/batch.go:11-21)."""
+    if pk.type_() == ED25519_KEY_TYPE:
+        return Ed25519BatchVerifier(), True
+    return None, False
+
+
+def supports_batch_verifier(pk: PubKey) -> bool:
+    """reference crypto/batch/batch.go:25-35."""
+    return pk is not None and pk.type_() == ED25519_KEY_TYPE

@@ -1,0 +1,143 @@
+"""Key interfaces and the ed25519 implementation.
+
+Mirrors the reference plugin surface (crypto/crypto.go:22-54: PubKey,
+PrivKey, BatchVerifier) so every call site — vote verification, commit
+batch verification, light client — goes through the same seam the
+reference uses, with the TPU kernel slotted in behind it
+(crypto/batch/batch.go:11-35 is re-created in `batch.py`).
+
+Single-signature verification uses ZIP-215 semantics, identical to the
+batch path (reference crypto/ed25519/ed25519.go:181-188) — verdict parity
+between single and batch verification is what makes batch-failure
+attribution sound.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional, Protocol, Sequence, Tuple, runtime_checkable
+
+from . import ref_ed25519 as ref
+
+ADDRESS_SIZE = 20  # reference crypto/tmhash/hash.go:78 (sha256, truncated)
+
+ED25519_KEY_TYPE = "ed25519"
+
+
+def address_from_pubkey_bytes(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()[:ADDRESS_SIZE]
+
+
+@runtime_checkable
+class PubKey(Protocol):
+    def address(self) -> bytes: ...
+    def bytes_(self) -> bytes: ...
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool: ...
+    def type_(self) -> str: ...
+
+
+@runtime_checkable
+class PrivKey(Protocol):
+    def sign(self, msg: bytes) -> bytes: ...
+    def pub_key(self) -> PubKey: ...
+    def bytes_(self) -> bytes: ...
+    def type_(self) -> str: ...
+
+
+class BatchVerifier(Protocol):
+    """reference crypto/crypto.go:46-54."""
+
+    def add(self, pk: PubKey, msg: bytes, sig: bytes) -> None: ...
+    def verify(self) -> Tuple[bool, List[bool]]: ...
+
+
+@dataclass(frozen=True)
+class Ed25519PubKey:
+    raw: bytes
+
+    def __post_init__(self):
+        if len(self.raw) != 32:
+            raise ValueError(f"ed25519 pubkey must be 32B, got {len(self.raw)}")
+
+    def address(self) -> bytes:
+        return address_from_pubkey_bytes(self.raw)
+
+    def bytes_(self) -> bytes:
+        return self.raw
+
+    def type_(self) -> str:
+        return ED25519_KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return ref.verify(self.raw, msg, sig, zip215=True)
+
+
+@dataclass(frozen=True)
+class Ed25519PrivKey:
+    seed: bytes
+
+    def __post_init__(self):
+        if len(self.seed) != 32:
+            raise ValueError("ed25519 seed must be 32B")
+
+    @classmethod
+    def generate(cls, rng=None) -> "Ed25519PrivKey":
+        import secrets
+        return cls(secrets.token_bytes(32) if rng is None
+                   else bytes(rng.randrange(256) for _ in range(32)))
+
+    def sign(self, msg: bytes) -> bytes:
+        # fast native signer when available; identical RFC 8032 output
+        try:
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PrivateKey)
+            return Ed25519PrivateKey.from_private_bytes(self.seed).sign(msg)
+        except ImportError:  # pragma: no cover
+            return ref.sign(self.seed, msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return Ed25519PubKey(ref.pubkey_from_seed(self.seed))
+
+    def bytes_(self) -> bytes:
+        return self.seed
+
+    def type_(self) -> str:
+        return ED25519_KEY_TYPE
+
+
+class Ed25519BatchVerifier:
+    """Accumulate-and-flush batch verifier backed by the TPU kernel
+    (replaces curve25519-voi's CPU batch, reference
+    crypto/ed25519/ed25519.go:208-241).
+
+    Unlike the reference — whose batch returns one bool plus a per-sig
+    attribution vector only on failure — the lane-parallel kernel always
+    produces per-signature verdicts, so `verify()` is exact attribution
+    with no fallback re-verification pass (types/validation.go:306-315).
+    """
+
+    def __init__(self, batch_size: Optional[int] = None):
+        self._pubs: List[bytes] = []
+        self._msgs: List[bytes] = []
+        self._sigs: List[bytes] = []
+        self._batch_size = batch_size
+
+    def __len__(self) -> int:
+        return len(self._pubs)
+
+    def add(self, pk: PubKey, msg: bytes, sig: bytes) -> None:
+        if pk.type_() != ED25519_KEY_TYPE:
+            raise TypeError(f"ed25519 batch verifier got {pk.type_()} key")
+        self._pubs.append(pk.bytes_())
+        self._msgs.append(msg)
+        self._sigs.append(sig)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        if not self._pubs:
+            return False, []
+        from ..ops.ed25519 import verify_batch
+        out = verify_batch(self._pubs, self._msgs, self._sigs,
+                           batch_size=self._batch_size)
+        oks = [bool(v) for v in out]
+        return all(oks), oks

@@ -1,0 +1,118 @@
+"""RFC-6962 Merkle tree over SHA-256 (reference crypto/merkle/tree.go,
+hash.go: leaf prefix 0x00, inner prefix 0x01, empty hash = sha256("")).
+
+Host-side hashlib implementation — header/validator-set hashing is a
+control-plane operation over dozens of items; the TPU data plane is for
+signatures. Proofs follow crypto/merkle/proof.go semantics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Sequence
+
+_LEAF = b"\x00"
+_INNER = b"\x01"
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(_LEAF + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(_INNER + left + right)
+
+
+def empty_hash() -> bytes:
+    return _sha256(b"")
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n."""
+    assert n > 1
+    k = 1 << (n.bit_length() - 1)
+    return k >> 1 if k == n else k
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    n = len(items)
+    if n == 0:
+        return empty_hash()
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]),
+                      hash_from_byte_slices(items[k:]))
+
+
+@dataclass
+class Proof:
+    """Merkle inclusion proof (reference crypto/merkle/proof.go:28-48)."""
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes]
+
+    def compute_root(self) -> bytes:
+        """Raises ValueError on malformed index/total/aunt shapes."""
+        return _compute_from_aunts(self.index, self.total, self.leaf_hash,
+                                   self.aunts)
+
+    def verify(self, root: bytes, leaf: bytes) -> bool:
+        """False (never raises) on attacker-controlled malformed proofs —
+        this sits on the gossip ingest path (PartSet.add_part)."""
+        if self.leaf_hash != leaf_hash(leaf):
+            return False
+        try:
+            return self.compute_root() == root
+        except ValueError:
+            return False
+
+
+def _compute_from_aunts(index: int, total: int, lh: bytes,
+                        aunts: List[bytes]) -> bytes:
+    if not (0 <= index < total):
+        raise ValueError(f"proof index {index} out of range for {total}")
+    if total == 1:
+        if aunts:
+            raise ValueError("unexpected aunts for single-leaf tree")
+        return lh
+    if not aunts:
+        raise ValueError("missing aunts")
+    k = _split_point(total)
+    if index < k:
+        left = _compute_from_aunts(index, k, lh, aunts[:-1])
+        return inner_hash(left, aunts[-1])
+    right = _compute_from_aunts(index - k, total - k, lh, aunts[:-1])
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: Sequence[bytes]
+                            ) -> tuple[bytes, List[Proof]]:
+    """Root hash + one inclusion proof per item
+    (reference crypto/merkle/proof.go ProofsFromByteSlices)."""
+    n = len(items)
+    leaves = [leaf_hash(it) for it in items]
+
+    def build(lo: int, hi: int) -> tuple[bytes, dict]:
+        if hi - lo == 1:
+            return leaves[lo], {lo: []}
+        k = _split_point(hi - lo)
+        lroot, lp = build(lo, lo + k)
+        rroot, rp = build(lo + k, hi)
+        proofs = {}
+        for i, aunts in lp.items():
+            proofs[i] = aunts + [rroot]
+        for i, aunts in rp.items():
+            proofs[i] = aunts + [lroot]
+        return inner_hash(lroot, rroot), proofs
+
+    if n == 0:
+        return empty_hash(), []
+    root, pmap = build(0, n)
+    return root, [Proof(n, i, leaves[i], pmap[i]) for i in range(n)]

@@ -91,7 +91,10 @@ def prepare_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
     the dummy on-device but report False.
     """
     n = len(pubs)
-    assert n == len(msgs) == len(sigs) and n <= batch_size
+    if not (n == len(msgs) == len(sigs)):
+        raise ValueError("pubs/msgs/sigs length mismatch")
+    if n > batch_size:
+        raise ValueError(f"{n} signatures exceed batch_size {batch_size}")
     dpub, dsig, dmsg = _dummy()
     max_blocks = (64 + max_msg_len + 17 + 127) // 128
 
@@ -123,18 +126,24 @@ def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
 
     batch_size defaults to the next power of two (one compiled kernel per
     bucket; production callers pick fixed tile sizes — see crypto.batch).
+    Inputs larger than batch_size are verified in batch_size-sized chunks.
     """
     n = len(pubs)
     if n == 0:
         return np.zeros((0,), dtype=bool)
     if batch_size is None:
         batch_size = 1 << (n - 1).bit_length()
-    max_msg_len = max((len(m) for m in msgs), default=0)
-    # bucket message capacity to limit kernel variants
-    cap = 64
-    while cap < max_msg_len:
-        cap *= 2
-    pub_a, sig_a, hb, hn, ok_mask = prepare_batch(
-        pubs, msgs, sigs, batch_size, cap)
-    out = np.asarray(verify_kernel(pub_a, sig_a, hb, hn, zip215=zip215))
-    return out[:n] & ok_mask[:n]
+    outs = []
+    for lo in range(0, n, batch_size):
+        hi = min(lo + batch_size, n)
+        chunk_msgs = msgs[lo:hi]
+        max_msg_len = max((len(m) for m in chunk_msgs), default=0)
+        # bucket message capacity to limit kernel variants
+        cap = 64
+        while cap < max_msg_len:
+            cap *= 2
+        pub_a, sig_a, hb, hn, ok_mask = prepare_batch(
+            pubs[lo:hi], chunk_msgs, sigs[lo:hi], batch_size, cap)
+        out = np.asarray(verify_kernel(pub_a, sig_a, hb, hn, zip215=zip215))
+        outs.append(out[:hi - lo] & ok_mask[:hi - lo])
+    return np.concatenate(outs)

@@ -149,18 +149,13 @@ def spread_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return acc.astype(jnp.int32)
 
 
-def _mul_accumulate(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """16x16-limb product -> 32 limbs, each < 2^21 (int32-safe)."""
-    return spread_mul(a, b)
-
-
 def _fold_mod_p(acc: jnp.ndarray) -> jnp.ndarray:
     # fold limbs 16..31 (weights 2^(16k), k>=16) via 2^256 ≡ 38 (mod p)
     return fe_carry(acc[..., :NLIMBS] + 38 * acc[..., NLIMBS:2 * NLIMBS])
 
 
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _fold_mod_p(_mul_accumulate(a, b))
+    return _fold_mod_p(spread_mul(a, b))
 
 
 def fe_square(a: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,194 @@
+"""Hand-rolled protobuf wire encoding for the consensus-critical messages.
+
+Wire-level parity with the reference is normative: one byte of difference in
+canonical sign-bytes breaks every signature (SURVEY §7 hard part (e)). The
+encoders below reproduce the exact emission rules of the reference's
+generated gogoproto marshalers (reference api/cometbft/types/v1/
+canonical.pb.go:598-648):
+
+- proto3 scalars are emitted iff non-zero / non-empty,
+- nullable embedded messages iff present,
+- NON-nullable embedded messages (e.g. timestamps, part_set_header) are
+  ALWAYS emitted, even when empty,
+- sfixed64 height/round in canonical messages (fixed-size encoding is what
+  makes the sign-bytes length predictable for hardware signers),
+- sign-bytes are varint-length-prefixed (reference internal/protoio,
+  types/vote.go:150 MarshalDelimited).
+
+Field numbers cited per message from the reference .proto files
+(proto/cometbft/types/v1/{canonical,types}.proto, crypto/v1/keys.proto,
+version/v1/types.proto).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# wire types
+_VARINT = 0
+_FIX64 = 1
+_BYTES = 2
+
+
+def uvarint(n: int) -> bytes:
+    assert n >= 0
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def varint(n: int) -> bytes:
+    """proto varint of an int64 (negative -> 10-byte two's complement)."""
+    return uvarint(n & 0xFFFFFFFFFFFFFFFF if n < 0 else n)
+
+
+def tag(field: int, wire: int) -> bytes:
+    return uvarint((field << 3) | wire)
+
+
+def f_varint(field: int, n: int) -> bytes:
+    """Scalar varint field, proto3 rule: omitted when zero."""
+    return b"" if n == 0 else tag(field, _VARINT) + varint(n)
+
+
+def f_sfixed64(field: int, n: int) -> bytes:
+    if n == 0:
+        return b""
+    return tag(field, _FIX64) + (n & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
+
+
+def f_bytes(field: int, b: bytes) -> bytes:
+    if not b:
+        return b""
+    return tag(field, _BYTES) + uvarint(len(b)) + b
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode("utf-8"))
+
+
+def f_embed(field: int, payload: bytes) -> bytes:
+    """Embedded message, ALWAYS emitted (gogoproto nullable=false)."""
+    return tag(field, _BYTES) + uvarint(len(payload)) + payload
+
+
+def f_embed_opt(field: int, payload: bytes | None) -> bytes:
+    """Embedded message pointer: omitted when None."""
+    return b"" if payload is None else f_embed(field, payload)
+
+
+def marshal_delimited(payload: bytes) -> bytes:
+    return uvarint(len(payload)) + payload
+
+
+# --- google.protobuf.Timestamp ----------------------------------------------
+
+@dataclass(frozen=True, order=True)
+class Timestamp:
+    """(seconds, nanos) since epoch, UTC — the canonical time form
+    (reference types/canonical.go:80-86 forces UTC)."""
+    seconds: int = 0
+    nanos: int = 0
+
+    def encode(self) -> bytes:
+        return f_varint(1, self.seconds) + f_varint(2, self.nanos)
+
+    @classmethod
+    def now(cls) -> "Timestamp":
+        import time
+        t = time.time_ns()
+        return cls(t // 1_000_000_000, t % 1_000_000_000)
+
+    def is_zero(self) -> bool:
+        return self.seconds == 0 and self.nanos == 0
+
+
+# --- canonical messages (proto/cometbft/types/v1/canonical.proto) -----------
+
+def canonical_part_set_header(total: int, hash_: bytes) -> bytes:
+    return f_varint(1, total) + f_bytes(2, hash_)
+
+
+def canonical_block_id(hash_: bytes, psh_total: int, psh_hash: bytes) -> bytes:
+    return (f_bytes(1, hash_)
+            + f_embed(2, canonical_part_set_header(psh_total, psh_hash)))
+
+
+def canonical_vote(type_: int, height: int, round_: int,
+                   block_id: bytes | None, ts: Timestamp,
+                   chain_id: str) -> bytes:
+    """CanonicalVote: type=1, height=2 sfixed64, round=3 sfixed64,
+    block_id=4 (nullable), timestamp=5 (non-nullable), chain_id=6."""
+    return (f_varint(1, type_)
+            + f_sfixed64(2, height)
+            + f_sfixed64(3, round_)
+            + f_embed_opt(4, block_id)
+            + f_embed(5, ts.encode())
+            + f_string(6, chain_id))
+
+
+def canonical_proposal(type_: int, height: int, round_: int, pol_round: int,
+                       block_id: bytes | None, ts: Timestamp,
+                       chain_id: str) -> bytes:
+    """CanonicalProposal: type=1, height=2 sfixed64, round=3 sfixed64,
+    pol_round=4 int64, block_id=5, timestamp=6, chain_id=7."""
+    return (f_varint(1, type_)
+            + f_sfixed64(2, height)
+            + f_sfixed64(3, round_)
+            + f_varint(4, pol_round & 0xFFFFFFFFFFFFFFFF if pol_round < 0
+                       else pol_round)
+            + f_embed_opt(5, block_id)
+            + f_embed(6, ts.encode())
+            + f_string(7, chain_id))
+
+
+def canonical_vote_extension(extension: bytes, height: int, round_: int,
+                             chain_id: str) -> bytes:
+    """CanonicalVoteExtension: extension=1, height=2 sfixed64,
+    round=3 sfixed64, chain_id=4."""
+    return (f_bytes(1, extension)
+            + f_sfixed64(2, height)
+            + f_sfixed64(3, round_)
+            + f_string(4, chain_id))
+
+
+# --- wrapper-value encodings (header field hashing) --------------------------
+
+def cdc_bytes(b: bytes) -> bytes:
+    """gogotypes.BytesValue{Value: b} proto bytes; nil-like inputs -> empty
+    (reference types/encoding_helper.go cdcEncode)."""
+    return f_bytes(1, b)
+
+
+def cdc_string(s: str) -> bytes:
+    return f_string(1, s)
+
+
+def cdc_int64(n: int) -> bytes:
+    return f_varint(1, n)
+
+
+# --- crypto keys & version (for validator-set / header hashing) --------------
+
+def public_key_proto(key_type: str, key_bytes: bytes) -> bytes:
+    """cometbft.crypto.v1.PublicKey oneof: ed25519=1, secp256k1=2,
+    bls12381=3 (reference proto/cometbft/crypto/v1/keys.proto)."""
+    field = {"ed25519": 1, "secp256k1": 2, "bls12381": 3}[key_type]
+    return tag(field, _BYTES) + uvarint(len(key_bytes)) + key_bytes
+
+
+def simple_validator(pubkey_proto: bytes, voting_power: int) -> bytes:
+    """SimpleValidator: pub_key=1 (nullable ptr), voting_power=2
+    (reference types/validator.go:118-133)."""
+    return f_embed_opt(1, pubkey_proto) + f_varint(2, voting_power)
+
+
+def consensus_version(block: int, app: int) -> bytes:
+    """cometbft.version.v1.Consensus: block=1, app=2."""
+    return f_varint(1, block) + f_varint(2, app)

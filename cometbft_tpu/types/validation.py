@@ -1,0 +1,191 @@
+"""Commit verification — single, batch, and trusting forms
+(reference types/validation.go).
+
+The batch path feeds the TPU kernel through the same plugin seam the
+reference uses (crypto/batch.create_batch_verifier); because the kernel is
+lane-parallel it returns per-signature verdicts, so failure attribution
+needs no second pass (reference falls back to per-sig loops,
+types/validation.go:306-315).
+
+The cross-commit tiling form (many commits → one device batch) lives in
+engine/blocksync; these functions are the per-commit semantics they must
+agree with.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..crypto import batch as crypto_batch
+from .block import Commit, CommitSig, BlockID
+from .validator import ValidatorSet
+
+BATCH_VERIFY_THRESHOLD = 2  # reference types/validation.go:13
+
+
+class CommitVerificationError(Exception):
+    pass
+
+
+class ErrInvalidCommitSignatures(CommitVerificationError):
+    pass
+
+
+class ErrNotEnoughVotingPowerSigned(CommitVerificationError):
+    def __init__(self, got: int, needed: int):
+        super().__init__(f"insufficient voting power: got {got}, "
+                         f"needed more than {needed}")
+        self.got = got
+        self.needed = needed
+
+
+class ErrWrongSignature(CommitVerificationError):
+    def __init__(self, idx: int, sig: bytes):
+        super().__init__(f"wrong signature (#{idx}): {sig.hex()}")
+        self.idx = idx
+
+
+@dataclass(frozen=True)
+class Fraction:
+    """reference libs/math/fraction.go."""
+    numerator: int
+    denominator: int
+
+
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+def _verify_basic(vals: ValidatorSet, commit: Commit, height: int,
+                  block_id: BlockID) -> None:
+    """reference types/validation.go:408-431."""
+    if vals is None:
+        raise CommitVerificationError("nil validator set")
+    if commit is None:
+        raise CommitVerificationError("nil commit")
+    if len(vals) != len(commit.signatures):
+        raise ErrInvalidCommitSignatures(
+            f"validator set size {len(vals)} != {len(commit.signatures)} sigs")
+    if height != commit.height:
+        raise CommitVerificationError(
+            f"invalid commit height: want {height}, got {commit.height}")
+    if block_id != commit.block_id:
+        raise CommitVerificationError("invalid commit -- wrong block ID")
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    prop = vals.get_proposer()
+    return (len(commit.signatures) >= BATCH_VERIFY_THRESHOLD
+            and prop is not None
+            and crypto_batch.supports_batch_verifier(prop.pub_key))
+
+
+def _verify_commit_core(chain_id: str, vals: ValidatorSet, commit: Commit,
+                        voting_power_needed: int,
+                        ignore: Callable[[CommitSig], bool],
+                        count: Callable[[CommitSig], bool],
+                        count_all: bool, lookup_by_index: bool) -> None:
+    """Shared body of the batch and single paths
+    (reference types/validation.go:218-322 and :331-405; one body here
+    because attribution is free with per-lane verdicts)."""
+    use_batch = _should_batch_verify(vals, commit)
+    bv = None
+    if use_batch:
+        bv, ok = crypto_batch.create_batch_verifier(
+            vals.get_proposer().pub_key)
+        use_batch = ok
+
+    tallied = 0
+    seen = {}
+    batch_idxs = []
+    for idx, cs in enumerate(commit.signatures):
+        if ignore(cs):
+            continue
+        try:
+            cs.validate_basic()
+        except ValueError as e:
+            raise CommitVerificationError(
+                f"invalid signature at index {idx}: {e}") from e
+
+        if lookup_by_index:
+            val = vals.get_by_index(idx)
+        else:
+            val_idx, val = vals.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen:
+                raise CommitVerificationError(
+                    f"double vote from validator {val_idx} "
+                    f"({seen[val_idx]} and {idx})")
+            seen[val_idx] = idx
+
+        msg = commit.vote_sign_bytes(chain_id, idx)
+        if use_batch:
+            bv.add(val.pub_key, msg, cs.signature)
+            batch_idxs.append(idx)
+        elif not val.pub_key.verify_signature(msg, cs.signature):
+            raise ErrWrongSignature(idx, cs.signature)
+
+        if count(cs):
+            tallied += val.voting_power
+        if not count_all and tallied > voting_power_needed:
+            break
+
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(tallied, voting_power_needed)
+
+    if use_batch and len(bv):
+        all_ok, oks = bv.verify()
+        if not all_ok:
+            first_bad = next(i for i, o in zip(batch_idxs, oks) if not o)
+            raise ErrWrongSignature(
+                first_bad, commit.signatures[first_bad].signature)
+
+
+def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
+                  height: int, commit: Commit) -> None:
+    """+2/3 signed, checking ALL signatures
+    (reference types/validation.go:26-53). Raises on failure."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    _verify_commit_core(
+        chain_id, vals, commit, needed,
+        ignore=lambda c: c.absent_(),
+        count=lambda c: c.for_block(),
+        count_all=True, lookup_by_index=True)
+
+
+def verify_commit_light(chain_id: str, vals: ValidatorSet, block_id: BlockID,
+                        height: int, commit: Commit,
+                        count_all: bool = False) -> None:
+    """+2/3 signed, early-exit once the threshold is reached — blocksync /
+    light-client form (reference types/validation.go:61-116)."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    _verify_commit_core(
+        chain_id, vals, commit, needed,
+        ignore=lambda c: not c.for_block(),
+        count=lambda _: True,
+        count_all=count_all, lookup_by_index=True)
+
+
+def verify_commit_light_trusting(chain_id: str, vals: ValidatorSet,
+                                 commit: Commit,
+                                 trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+                                 count_all: bool = False) -> None:
+    """trustLevel of a TRUSTED validator set signed this commit — validators
+    matched by address, unknown signers skipped, double votes rejected
+    (reference types/validation.go:118-215)."""
+    if vals is None:
+        raise CommitVerificationError("nil validator set")
+    if commit is None:
+        raise CommitVerificationError("nil commit")
+    if trust_level.denominator == 0:
+        raise CommitVerificationError("trustLevel has zero denominator")
+    needed = (vals.total_voting_power()
+              * trust_level.numerator) // trust_level.denominator
+    _verify_commit_core(
+        chain_id, vals, commit, needed,
+        ignore=lambda c: not c.for_block(),
+        count=lambda _: True,
+        count_all=count_all, lookup_by_index=False)

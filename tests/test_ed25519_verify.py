@@ -111,3 +111,14 @@ def test_zip215_small_order_and_noncanonical():
 
 def test_empty_batch():
     assert verify_batch([], [], []).shape == (0,)
+
+
+def test_oversized_batch_chunks():
+    """More signatures than batch_size must chunk, not crash."""
+    seed = b"\x05" * 32
+    pub = ref.pubkey_from_seed(seed)
+    msgs = [bytes([i]) for i in range(5)]
+    sigs = [ref.sign(seed, m) for m in msgs]
+    sigs[3] = bytes(64)
+    got = verify_batch([pub] * 5, msgs, sigs, batch_size=2)
+    assert list(got) == [True, True, True, False, True]

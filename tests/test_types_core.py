@@ -1,0 +1,243 @@
+"""Merkle tree, validator set, header hashing, and commit verification
+(single + TPU batch paths) against reference semantics."""
+
+import hashlib
+import random
+
+import pytest
+
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.types import proto as P
+from cometbft_tpu.types.block import (
+    BlockID, PartSetHeader, CommitSig, Commit, Header, Data, Block,
+    BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL,
+)
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import Vote, PRECOMMIT_TYPE
+from cometbft_tpu.types import validation
+from cometbft_tpu.types.validation import (
+    verify_commit, verify_commit_light, verify_commit_light_trusting,
+    Fraction, ErrWrongSignature, ErrNotEnoughVotingPowerSigned,
+    CommitVerificationError,
+)
+
+RNG = random.Random(42)
+
+
+def _sha(b):
+    return hashlib.sha256(b).digest()
+
+
+def test_merkle_rfc6962_vectors():
+    # empty tree = sha256("")
+    assert merkle.hash_from_byte_slices([]) == _sha(b"")
+    # single leaf = sha256(0x00 || leaf)
+    assert merkle.hash_from_byte_slices([b"x"]) == _sha(b"\x00x")
+    # two leaves = sha256(0x01 || h0 || h1)
+    h0, h1 = _sha(b"\x00a"), _sha(b"\x00b")
+    assert merkle.hash_from_byte_slices([b"a", b"b"]) == _sha(b"\x01" + h0 + h1)
+    # three leaves: split point 2 -> inner(inner(h0,h1), h2)
+    h2 = _sha(b"\x00c")
+    want = _sha(b"\x01" + _sha(b"\x01" + h0 + h1) + h2)
+    assert merkle.hash_from_byte_slices([b"a", b"b", b"c"]) == want
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+def test_merkle_proofs_roundtrip(n):
+    items = [bytes([i]) * (i + 1) for i in range(n)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, pr in enumerate(proofs):
+        assert pr.verify(root, items[i])
+        assert not pr.verify(root, items[i] + b"!")
+        if n > 1:
+            bad = merkle.Proof(pr.total, pr.index, pr.leaf_hash,
+                               [b"\x00" * 32] * len(pr.aunts))
+            assert not bad.verify(root, items[i])
+
+
+def _make_valset(n, power=None):
+    keys = [Ed25519PrivKey(bytes([i + 1]) * 32) for i in range(n)]
+    vals = [Validator(k.pub_key(), power[i] if power else 10)
+            for i, k in enumerate(keys)]
+    vs = ValidatorSet(vals)
+    keymap = {k.pub_key().address(): k for k in keys}
+    return vs, keymap
+
+
+def test_validator_set_ordering_and_hash():
+    vs, _ = _make_valset(5, power=[5, 50, 10, 10, 1])
+    powers = [v.voting_power for v in vs.validators]
+    assert powers == sorted(powers, reverse=True)
+    # equal-power validators sorted by address
+    eq = [v for v in vs.validators if v.voting_power == 10]
+    assert eq[0].address < eq[1].address
+    assert len(vs.hash()) == 32
+    assert vs.total_voting_power() == 76
+
+
+def test_proposer_rotation_fair():
+    """Over many rounds, proposer frequency tracks voting power
+    (reference types/validator_set.go proposer selection invariant)."""
+    vs, _ = _make_valset(3, power=[1, 2, 3])
+    counts = {}
+    cur = vs
+    for _ in range(600):
+        addr = cur.get_proposer().address
+        counts[addr] = counts.get(addr, 0) + 1
+        cur = cur.copy_increment_proposer_priority(1)
+    by_power = sorted(counts.values())
+    assert by_power == [100, 200, 300], by_power
+
+
+def _signed_commit(vs, keymap, chain_id="bench-chain", height=10, round_=1,
+                   nil_idxs=(), absent_idxs=(), bad_idxs=()):
+    bid = BlockID(hash=b"\xab" * 32, parts=PartSetHeader(1, b"\xcd" * 32))
+    sigs = []
+    for i, val in enumerate(vs.validators):
+        if i in absent_idxs:
+            sigs.append(CommitSig.absent())
+            continue
+        flag = BLOCK_ID_FLAG_NIL if i in nil_idxs else BLOCK_ID_FLAG_COMMIT
+        ts = P.Timestamp(1700000000 + i, i)
+        v = Vote(type_=PRECOMMIT_TYPE, height=height, round=round_,
+                 block_id=bid if flag == BLOCK_ID_FLAG_COMMIT else BlockID(),
+                 timestamp=ts, validator_address=val.address,
+                 validator_index=i)
+        sig = keymap[val.address].sign(v.sign_bytes(chain_id))
+        if i in bad_idxs:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        sigs.append(CommitSig(flag, val.address, ts, sig))
+    return Commit(height=height, round=round_, block_id=bid, signatures=sigs), bid
+
+
+def test_verify_commit_all_good():
+    vs, keymap = _make_valset(6)
+    commit, bid = _signed_commit(vs, keymap)
+    verify_commit("bench-chain", vs, bid, 10, commit)
+    verify_commit_light("bench-chain", vs, bid, 10, commit)
+    verify_commit_light_trusting("bench-chain", vs, commit, Fraction(1, 3))
+
+
+def test_verify_commit_with_nil_and_absent():
+    vs, keymap = _make_valset(6)
+    # 4 of 6 for the block (power 40/60 > 2/3*60=40? 40 > 40 false!) -> use 5
+    commit, bid = _signed_commit(vs, keymap, nil_idxs=(5,))
+    verify_commit("bench-chain", vs, bid, 10, commit)
+    commit, bid = _signed_commit(vs, keymap, nil_idxs=(4,), absent_idxs=(5,))
+    # 4*10=40 not > 40 -> insufficient
+    with pytest.raises(ErrNotEnoughVotingPowerSigned):
+        verify_commit("bench-chain", vs, bid, 10, commit)
+
+
+def test_verify_commit_bad_signature_attribution():
+    vs, keymap = _make_valset(6)
+    commit, bid = _signed_commit(vs, keymap, bad_idxs=(3,))
+    with pytest.raises(ErrWrongSignature) as ei:
+        verify_commit("bench-chain", vs, bid, 10, commit)
+    assert ei.value.idx == 3
+
+
+def test_verify_commit_light_skips_bad_nil_votes():
+    """Light verify ignores non-commit votes entirely — a corrupted nil
+    vote must not fail it (reference validation.go:100-104)."""
+    vs, keymap = _make_valset(6)
+    commit, bid = _signed_commit(vs, keymap, nil_idxs=(5,), bad_idxs=(5,))
+    verify_commit_light("bench-chain", vs, bid, 10, commit)
+    # but full verify_commit checks ALL signatures including nil votes
+    with pytest.raises(ErrWrongSignature):
+        verify_commit("bench-chain", vs, bid, 10, commit)
+
+
+def test_verify_commit_wrong_shape():
+    vs, keymap = _make_valset(4)
+    commit, bid = _signed_commit(vs, keymap)
+    with pytest.raises(CommitVerificationError):
+        verify_commit("bench-chain", vs, bid, 11, commit)  # wrong height
+    with pytest.raises(CommitVerificationError):
+        verify_commit("wrong-chain", vs, bid, 10, commit)  # breaks all sigs
+    vs5, _ = _make_valset(5)
+    with pytest.raises(CommitVerificationError):
+        verify_commit("bench-chain", vs5, bid, 10, commit)  # size mismatch
+
+
+def test_verify_commit_light_trusting_by_address():
+    """Trusting path looks up by address: works with a different
+    (overlapping) validator set ordering/subset."""
+    vs, keymap = _make_valset(6)
+    commit, bid = _signed_commit(vs, keymap)
+    # trusted set = subset with different powers (re-sorts differently)
+    subset = ValidatorSet([Validator(v.pub_key, 100 - 10 * i)
+                           for i, v in enumerate(vs.validators[:4])])
+    verify_commit_light_trusting("bench-chain", subset, commit,
+                                 Fraction(1, 3))
+
+
+def test_header_and_block_hashing():
+    vs, _ = _make_valset(3)
+    h = Header(version_block=11, chain_id="c", height=3,
+               time=P.Timestamp(100, 5),
+               last_block_id=BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32)),
+               validators_hash=vs.hash(), next_validators_hash=vs.hash(),
+               consensus_hash=b"\x03" * 32, app_hash=b"",
+               proposer_address=vs.validators[0].address)
+    hh = h.hash()
+    assert len(hh) == 32
+    assert hh == h.hash()
+    assert Header().hash() == b""  # incomplete header -> nil hash
+    # different field -> different hash
+    import dataclasses
+    h2 = dataclasses.replace(h, height=4)
+    assert h2.hash() != hh
+
+
+def test_block_part_set_roundtrip():
+    data = Data(txs=[b"tx-%d" % i * 50 for i in range(100)])
+    blk = Block(header=Header(chain_id="c", height=1,
+                              validators_hash=b"\x01" * 32,
+                              proposer_address=b"\x02" * 20),
+                data=data)
+    ps = blk.make_part_set(part_size=256)
+    assert ps.header.total > 1
+    # reassemble from verified parts
+    ps2 = ps.new_from_header(ps.header)
+    for part in ps.parts:
+        assert ps2.add_part(part)
+    assert ps2.reassemble() == blk.encode()
+    # a corrupted part is rejected by its merkle proof
+    bad = ps.parts[0]
+    bad = type(bad)(bad.index, bad.bytes_ + b"!", bad.proof)
+    ps3 = ps.new_from_header(ps.header)
+    assert not ps3.add_part(bad)
+
+
+def test_part_replay_at_wrong_index_rejected():
+    """A valid part re-sent under a different index must be rejected
+    (reference types/part_set.go Part.ValidateBasic)."""
+    data = Data(txs=[b"tx-%d" % i * 50 for i in range(50)])
+    blk = Block(header=Header(chain_id="c", height=1,
+                              validators_hash=b"\x01" * 32,
+                              proposer_address=b"\x02" * 20),
+                data=data)
+    ps = blk.make_part_set(part_size=256)
+    assert ps.header.total >= 2
+    p0 = ps.parts[0]
+    replay = type(p0)(1, p0.bytes_, p0.proof)  # index 1, proof for index 0
+    fresh = ps.new_from_header(ps.header)
+    assert not fresh.add_part(replay)
+    # malformed proof shapes return False, never raise
+    bad_proof = merkle.Proof(total=0, index=-1, leaf_hash=p0.proof.leaf_hash,
+                             aunts=[])
+    assert not bad_proof.verify(ps.header.hash, p0.bytes_)
+    wrong_aunts = merkle.Proof(p0.proof.total, p0.proof.index,
+                               p0.proof.leaf_hash, [])
+    assert not wrong_aunts.verify(ps.header.hash, p0.bytes_)
+
+
+def test_commit_hash_changes_with_sigs():
+    vs, keymap = _make_valset(4)
+    commit, _ = _signed_commit(vs, keymap)
+    h1 = commit.hash()
+    commit.signatures[0] = CommitSig.absent()
+    assert commit.hash() != h1
