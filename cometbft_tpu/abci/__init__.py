@@ -1,0 +1,6 @@
+from .application import (  # noqa: F401
+    Application, BaseApplication, RequestFinalizeBlock, ResponseFinalizeBlock,
+    ExecTxResult, ValidatorUpdate, CheckTxResult, ResponseInfo,
+    ResponseCommit, CODE_TYPE_OK,
+)
+from .kvstore import KVStoreApplication  # noqa: F401

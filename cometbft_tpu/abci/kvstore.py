@@ -1,0 +1,128 @@
+"""In-process key-value example application (reference
+abci/example/kvstore/kvstore.go) — the standard fake backend for engine
+tests and benchmarks.
+
+Tx formats:
+  "key=value"                   store a pair
+  "val:<pubkey_hex>!<power>"    validator power update
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import List
+
+from .application import (
+    BaseApplication, CheckTxResult, ExecTxResult, RequestFinalizeBlock,
+    ResponseCommit, ResponseFinalizeBlock, ResponseInfo, ValidatorUpdate,
+    CODE_TYPE_OK,
+)
+
+CODE_TYPE_INVALID_FORMAT = 1
+
+VALIDATOR_PREFIX = b"val:"
+
+
+class KVStoreApplication(BaseApplication):
+    def __init__(self):
+        self.state: dict = {}
+        self.pending_updates: List[ValidatorUpdate] = []
+        self.last_height = 0
+        self.last_app_hash = b""
+        self.staged: dict | None = None
+
+    # --- helpers -------------------------------------------------------------
+
+    def _compute_app_hash(self, state: dict, height: int) -> bytes:
+        blob = json.dumps(
+            {k: state[k] for k in sorted(state)}, separators=(",", ":"),
+        ).encode() + height.to_bytes(8, "big")
+        return hashlib.sha256(blob).digest()
+
+    @staticmethod
+    def is_validator_tx(tx: bytes) -> bool:
+        return tx.startswith(VALIDATOR_PREFIX)
+
+    # --- mempool -------------------------------------------------------------
+
+    def check_tx(self, tx: bytes) -> CheckTxResult:
+        if self.is_validator_tx(tx):
+            try:
+                self._parse_validator_tx(tx)
+                return CheckTxResult(code=CODE_TYPE_OK, gas_wanted=1)
+            except ValueError as e:
+                return CheckTxResult(code=CODE_TYPE_INVALID_FORMAT,
+                                     log=str(e))
+        if b"=" not in tx:
+            return CheckTxResult(code=CODE_TYPE_INVALID_FORMAT,
+                                 log="tx must be key=value")
+        return CheckTxResult(code=CODE_TYPE_OK, gas_wanted=1)
+
+    def _parse_validator_tx(self, tx: bytes) -> ValidatorUpdate:
+        body = tx[len(VALIDATOR_PREFIX):].decode()
+        if "!" not in body:
+            raise ValueError("val tx must be val:<pubkey_hex>!<power>")
+        pk_hex, power_s = body.split("!", 1)
+        pk = bytes.fromhex(pk_hex)
+        if len(pk) != 32:
+            raise ValueError("pubkey must be 32 bytes")
+        return ValidatorUpdate("ed25519", pk, int(power_s))
+
+    # --- consensus -----------------------------------------------------------
+
+    def init_chain(self, chain_id, initial_height, validators,
+                   app_state_bytes):
+        if app_state_bytes:
+            self.state = json.loads(app_state_bytes)
+        return [], self._compute_app_hash(self.state, 0)
+
+    def info(self) -> ResponseInfo:
+        return ResponseInfo(data="kvstore-tpu", version="1",
+                            last_block_height=self.last_height,
+                            last_block_app_hash=self.last_app_hash)
+
+    def process_proposal(self, txs, height) -> bool:
+        return all(self.check_tx(tx).code == CODE_TYPE_OK for tx in txs)
+
+    def finalize_block(self, req: RequestFinalizeBlock
+                       ) -> ResponseFinalizeBlock:
+        state = dict(self.state)
+        results, updates = [], []
+        for tx in req.txs:
+            if self.is_validator_tx(tx):
+                try:
+                    upd = self._parse_validator_tx(tx)
+                except ValueError as e:
+                    results.append(ExecTxResult(
+                        code=CODE_TYPE_INVALID_FORMAT, log=str(e)))
+                    continue
+                updates.append(upd)
+                results.append(ExecTxResult(data=tx))
+            elif b"=" in tx:
+                k, v = tx.split(b"=", 1)
+                state[k.decode(errors="replace")] = v.decode(errors="replace")
+                results.append(ExecTxResult(data=tx))
+            else:
+                results.append(ExecTxResult(code=CODE_TYPE_INVALID_FORMAT,
+                                            log="tx must be key=value"))
+        app_hash = self._compute_app_hash(state, req.height)
+        self.staged = state
+        self.last_height = req.height
+        self.last_app_hash = app_hash
+        self.pending_updates = updates
+        return ResponseFinalizeBlock(tx_results=results,
+                                     validator_updates=updates,
+                                     app_hash=app_hash)
+
+    def commit(self) -> ResponseCommit:
+        if self.staged is not None:
+            self.state = self.staged
+            self.staged = None
+        return ResponseCommit(retain_height=0)
+
+    def query(self, path: str, data: bytes) -> tuple[int, bytes]:
+        if path == "/store" or path == "":
+            v = self.state.get(data.decode(errors="replace"))
+            return CODE_TYPE_OK, (v.encode() if v is not None else b"")
+        return 1, b"unknown path"

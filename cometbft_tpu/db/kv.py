@@ -1,0 +1,177 @@
+"""Key-value storage backends (the cometbft-db seam, reference go.mod:42,
+node/node.go:284).
+
+Two built-in backends:
+- MemDB: ordered in-memory map (the memdb analog used across tests),
+- FileDB: append-only log + in-memory index with compaction — a simple
+  durable store. (A C++ LSM backend slots in behind the same interface;
+  see db/native.)
+
+Iteration is ordered by raw bytes, matching goleveldb semantics the
+reference relies on for height-ordered scans.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from bisect import bisect_left, insort
+from typing import Dict, Iterator, List, Optional, Protocol, Tuple
+
+
+class KVStore(Protocol):
+    def get(self, key: bytes) -> Optional[bytes]: ...
+    def set(self, key: bytes, value: bytes) -> None: ...
+    def delete(self, key: bytes) -> None: ...
+    def iterate(self, start: bytes = b"", end: Optional[bytes] = None
+                ) -> Iterator[Tuple[bytes, bytes]]: ...
+    def write_batch(self, sets: List[Tuple[bytes, bytes]],
+                    deletes: List[bytes] = ()) -> None: ...
+    def close(self) -> None: ...
+
+
+class MemDB:
+    def __init__(self):
+        self._data: Dict[bytes, bytes] = {}
+        self._keys: List[bytes] = []
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            if key not in self._data:
+                insort(self._keys, key)
+            self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            if key in self._data:
+                del self._data[key]
+                i = bisect_left(self._keys, key)
+                self._keys.pop(i)
+
+    def iterate(self, start: bytes = b"", end: Optional[bytes] = None
+                ) -> Iterator[Tuple[bytes, bytes]]:
+        with self._lock:
+            i = bisect_left(self._keys, start)
+            keys = self._keys[i:]
+            snapshot = [(k, self._data[k]) for k in keys
+                        if end is None or k < end]
+        yield from snapshot
+
+    def write_batch(self, sets, deletes=()):
+        with self._lock:
+            for k, v in sets:
+                self.set(k, v)
+            for k in deletes:
+                self.delete(k)
+
+    def close(self):
+        pass
+
+
+_REC_SET = 0
+_REC_DEL = 1
+
+
+class FileDB:
+    """Append-only log with full in-memory index.
+
+    Record: u8 op | u32 klen | u32 vlen | key | value. Reopen replays the
+    log; `compact()` rewrites live records. Durability knob `fsync` mirrors
+    the role of the WAL's sync flag (reference internal/autofile)."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self._fsync = fsync
+        self._mem = MemDB()
+        self._lock = threading.RLock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            good = self._replay()
+            if good != os.path.getsize(path):
+                # torn tail from a crash mid-append: truncate it, else new
+                # appends land after garbage and are lost on next replay
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+        self._f = open(path, "ab")
+
+    def _replay(self) -> int:
+        """Replay the log; returns the offset of the last complete record."""
+        good = 0
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(9)
+                if len(hdr) < 9:
+                    break
+                op, klen, vlen = struct.unpack("<BII", hdr)
+                kv = f.read(klen + vlen)
+                if len(kv) < klen + vlen:
+                    break  # torn tail write (crash recovery)
+                good += 9 + klen + vlen
+                key, value = kv[:klen], kv[klen:]
+                if op == _REC_SET:
+                    self._mem.set(key, value)
+                else:
+                    self._mem.delete(key)
+        return good
+
+    def _append(self, op: int, key: bytes, value: bytes = b""):
+        rec = struct.pack("<BII", op, len(key), len(value)) + key + value
+        self._f.write(rec)
+        self._f.flush()
+        if self._fsync:
+            os.fsync(self._f.fileno())
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._mem.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._append(_REC_SET, key, value)
+            self._mem.set(key, value)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._append(_REC_DEL, key)
+            self._mem.delete(key)
+
+    def iterate(self, start: bytes = b"", end: Optional[bytes] = None):
+        return self._mem.iterate(start, end)
+
+    def write_batch(self, sets, deletes=()):
+        with self._lock:
+            for k, v in sets:
+                self._append(_REC_SET, k, v)
+                self._mem.set(k, v)
+            for k in deletes:
+                self._append(_REC_DEL, k)
+                self._mem.delete(k)
+
+    def compact(self):
+        with self._lock:
+            tmp = self.path + ".compact"
+            with open(tmp, "wb") as f:
+                for k, v in self._mem.iterate():
+                    f.write(struct.pack("<BII", _REC_SET, len(k), len(v))
+                            + k + v)
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "ab")
+
+    def close(self):
+        self._f.close()
+
+
+def open_db(backend: str, name: str, directory: str) -> KVStore:
+    if backend == "memdb":
+        return MemDB()
+    if backend == "filedb":
+        return FileDB(os.path.join(directory, f"{name}.db"))
+    raise ValueError(f"unknown db backend {backend!r}")

@@ -1,0 +1,2 @@
+from .state import State  # noqa: F401
+from .execution import BlockExecutor  # noqa: F401

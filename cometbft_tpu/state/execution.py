@@ -1,0 +1,189 @@
+"""Block executor: validate → finalize (ABCI) → update state
+(reference state/execution.go:109-340, state/validation.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import List, Optional, Tuple
+
+from ..abci.application import (
+    Application, RequestFinalizeBlock, ResponseFinalizeBlock, ValidatorUpdate)
+from ..crypto import merkle
+from ..crypto.keys import Ed25519PubKey
+from ..types import validation
+from ..types.block import Block, BlockID, Commit
+from ..types.validator import Validator
+from .state import State
+
+
+class BlockValidationError(Exception):
+    pass
+
+
+def validate_block(state: State, block: Block,
+                   check_commit: bool = True) -> None:
+    """Full header/commit validation against state
+    (reference state/validation.go:14-190).
+
+    check_commit=False skips the last-commit signature verification for
+    callers that already verified it out-of-band (blocksync's tiled
+    verifier covers the identical commit bytes with full semantics; the
+    last_commit_hash binding below still ties them to this block)."""
+    h = block.header
+    h.validate_basic()
+    if h.chain_id != state.chain_id:
+        raise BlockValidationError(
+            f"wrong chain id: got {h.chain_id}, want {state.chain_id}")
+    if h.height != state.last_block_height + 1 and \
+            h.height != state.initial_height:
+        raise BlockValidationError(
+            f"wrong height {h.height}, expected {state.last_block_height + 1}")
+    if h.last_block_id != state.last_block_id:
+        raise BlockValidationError("wrong last_block_id")
+    if h.last_commit_hash != block.last_commit.hash():
+        raise BlockValidationError("wrong last_commit_hash")
+    if h.data_hash != block.data.hash():
+        raise BlockValidationError("wrong data_hash")
+    if h.validators_hash != state.validators.hash():
+        raise BlockValidationError("wrong validators_hash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise BlockValidationError("wrong next_validators_hash")
+    if h.consensus_hash != state.consensus_params.hash():
+        raise BlockValidationError("wrong consensus_hash")
+    if h.app_hash != state.app_hash:
+        raise BlockValidationError("wrong app_hash")
+    if h.last_results_hash != state.last_results_hash:
+        raise BlockValidationError("wrong last_results_hash")
+
+    if h.height == state.initial_height:
+        if block.last_commit.signatures:
+            raise BlockValidationError(
+                "initial block must have empty last commit")
+    elif check_commit:
+        # verify the previous block's commit with the set that signed it
+        validation.verify_commit(
+            state.chain_id, state.last_validators, state.last_block_id,
+            h.height - 1, block.last_commit)
+
+    if not state.validators.has_address(h.proposer_address):
+        raise BlockValidationError("proposer not in validator set")
+
+
+def results_hash(tx_results) -> bytes:
+    """reference types/results.go ABCIResults.Hash (merkle over
+    deterministic result encodings)."""
+    return merkle.hash_from_byte_slices([r.encode() for r in tx_results])
+
+
+def validator_updates_to_validators(updates: List[ValidatorUpdate]
+                                    ) -> List[Validator]:
+    out = []
+    for u in updates:
+        if u.pub_key_type != "ed25519":
+            raise BlockValidationError(
+                f"unsupported validator key type {u.pub_key_type}")
+        out.append(Validator(Ed25519PubKey(u.pub_key_bytes), u.power))
+    return out
+
+
+class BlockExecutor:
+    """reference state/execution.go:71-120 (construction), :218 ApplyBlock,
+    :109 CreateProposalBlock."""
+
+    def __init__(self, app: Application, state_store=None, block_store=None,
+                 mempool=None, evidence_pool=None):
+        self.app = app
+        self.state_store = state_store
+        self.block_store = block_store
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+
+    # --- proposal path ------------------------------------------------------
+
+    def create_proposal_block(self, height: int, state: State,
+                              last_commit: Commit,
+                              proposer_address: bytes) -> Block:
+        """reference state/execution.go:109-166."""
+        max_bytes = state.consensus_params.max_block_bytes
+        txs: List[bytes] = []
+        if self.mempool is not None:
+            txs = self.mempool.reap_max_bytes_max_gas(
+                max_bytes - 2048, state.consensus_params.max_gas)
+        txs = self.app.prepare_proposal(txs, max_bytes - 2048)
+        return state.make_block(height, txs, last_commit, proposer_address)
+
+    def process_proposal(self, block: Block, state: State) -> bool:
+        """reference state/execution.go:169-196."""
+        return self.app.process_proposal(block.data.txs, block.header.height)
+
+    # --- apply path ---------------------------------------------------------
+
+    def validate_block(self, state: State, block: Block,
+                       check_commit: bool = True) -> None:
+        validate_block(state, block, check_commit=check_commit)
+
+    def apply_block(self, state: State, block_id: BlockID, block: Block,
+                    verified: bool = False) -> Tuple[State, ResponseFinalizeBlock]:
+        """Validate (unless pre-verified), FinalizeBlock against the app,
+        update state, commit (reference state/execution.go:218-340)."""
+        if not verified:
+            validate_block(state, block)
+
+        resp = self.app.finalize_block(RequestFinalizeBlock(
+            txs=block.data.txs,
+            height=block.header.height,
+            time=block.header.time,
+            proposer_address=block.header.proposer_address,
+            hash=block.hash(),
+            next_validators_hash=block.header.next_validators_hash,
+        ))
+        if len(resp.tx_results) != len(block.data.txs):
+            raise BlockValidationError(
+                "app returned wrong number of tx results")
+
+        new_state = self._update_state(state, block_id, block, resp)
+
+        if self.state_store is not None:
+            self.state_store.save_finalize_block_response(
+                block.header.height, resp.encode())
+
+        # app commit + mempool update (reference execution.go:296,390)
+        if self.mempool is not None:
+            self.mempool.lock()
+        try:
+            self.app.commit()
+            if self.mempool is not None:
+                self.mempool.update(block.header.height, block.data.txs,
+                                    resp.tx_results)
+        finally:
+            if self.mempool is not None:
+                self.mempool.unlock()
+
+        if self.state_store is not None:
+            self.state_store.save(new_state)
+        return new_state, resp
+
+    def _update_state(self, state: State, block_id: BlockID, block: Block,
+                      resp: ResponseFinalizeBlock) -> State:
+        """reference state/execution.go:597-672."""
+        n_valset = state.next_validators.copy()
+        last_changed = state.last_height_validators_changed
+        updates = validator_updates_to_validators(resp.validator_updates)
+        if updates:
+            n_valset.update_with_change_set(updates)
+            last_changed = block.header.height + 2
+        n_valset.increment_proposer_priority(1)
+
+        return replace(
+            state,
+            last_block_height=block.header.height,
+            last_block_id=block_id,
+            last_block_time=block.header.time,
+            next_validators=n_valset,
+            validators=state.next_validators.copy(),
+            last_validators=state.validators.copy(),
+            last_height_validators_changed=last_changed,
+            last_results_hash=results_hash(resp.tx_results),
+            app_hash=resp.app_hash,
+        )

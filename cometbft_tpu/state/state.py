@@ -1,0 +1,242 @@
+"""Replicated state: the deterministic snapshot between blocks
+(reference state/state.go — validators, params, last-block info,
+last-results), plus genesis bootstrapping (types/genesis.go).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field as dc_field, replace
+from typing import List, Optional
+
+from ..crypto import merkle
+from ..crypto.keys import Ed25519PubKey
+from ..types import proto
+from ..types.block import Block, BlockID, Commit, Data, Header
+from ..types.proto import Timestamp
+from ..types.validator import Validator, ValidatorSet
+
+
+@dataclass
+class ConsensusParams:
+    """Minimal on-chain params (reference types/params.go): block size
+    caps and evidence windows; hashed into Header.consensus_hash."""
+    max_block_bytes: int = 22_020_096   # 21MB, types/params.go
+    max_gas: int = -1
+    evidence_max_age_num_blocks: int = 100_000
+    evidence_max_age_seconds: int = 172_800
+    evidence_max_bytes: int = 1_048_576
+    pbts_enable_height: int = 0
+
+    def hash(self) -> bytes:
+        """Deterministic digest (reference HashConsensusParams hashes the
+        proto; ours hashes our own canonical encoding — node-local, not
+        wire-normative)."""
+        import hashlib
+        enc = (proto.f_varint(1, self.max_block_bytes)
+               + proto.f_varint(2, self.max_gas & 0xFFFFFFFFFFFFFFFF)
+               + proto.f_varint(3, self.evidence_max_age_num_blocks)
+               + proto.f_varint(4, self.evidence_max_age_seconds)
+               + proto.f_varint(5, self.evidence_max_bytes))
+        return hashlib.sha256(enc).digest()
+
+
+@dataclass
+class GenesisDoc:
+    """reference types/genesis.go."""
+    chain_id: str
+    validators: List[Validator]
+    genesis_time: Timestamp = dc_field(default_factory=Timestamp)
+    initial_height: int = 1
+    consensus_params: ConsensusParams = dc_field(
+        default_factory=ConsensusParams)
+    app_state: bytes = b""
+    app_hash: bytes = b""
+
+
+@dataclass
+class State:
+    """reference state/state.go:36-90."""
+    chain_id: str
+    initial_height: int
+    last_block_height: int
+    last_block_id: BlockID
+    last_block_time: Timestamp
+    validators: ValidatorSet         # valset for height last_block_height+1
+    next_validators: ValidatorSet    # valset for height +2
+    last_validators: ValidatorSet    # valset that signed last_block
+    last_height_validators_changed: int
+    consensus_params: ConsensusParams
+    last_results_hash: bytes
+    app_hash: bytes
+    version_block: int = 11
+    version_app: int = 0
+
+    @classmethod
+    def from_genesis(cls, gen: GenesisDoc) -> "State":
+        """reference state/state.go MakeGenesisState."""
+        vals = ValidatorSet(gen.validators)
+        return cls(
+            chain_id=gen.chain_id,
+            initial_height=gen.initial_height,
+            last_block_height=0,
+            last_block_id=BlockID(),
+            last_block_time=gen.genesis_time,
+            validators=vals.copy(),
+            next_validators=vals.copy_increment_proposer_priority(1),
+            last_validators=ValidatorSet([]),
+            last_height_validators_changed=gen.initial_height,
+            consensus_params=gen.consensus_params,
+            last_results_hash=merkle.hash_from_byte_slices([]),
+            app_hash=gen.app_hash,
+        )
+
+    def copy(self) -> "State":
+        return replace(
+            self,
+            validators=self.validators.copy(),
+            next_validators=self.next_validators.copy(),
+            last_validators=self.last_validators.copy())
+
+    def make_block(self, height: int, txs: List[bytes], last_commit: Commit,
+                   proposer_address: bytes,
+                   timestamp: Optional[Timestamp] = None) -> Block:
+        """reference state/state.go:233-263."""
+        if timestamp is None:
+            timestamp = (self.last_block_time if height == self.initial_height
+                         else Timestamp.now())
+        data = Data(txs=list(txs))
+        header = Header(
+            version_block=self.version_block,
+            version_app=self.version_app,
+            chain_id=self.chain_id,
+            height=height,
+            time=timestamp,
+            last_block_id=self.last_block_id,
+            last_commit_hash=last_commit.hash(),
+            data_hash=data.hash(),
+            validators_hash=self.validators.hash(),
+            next_validators_hash=self.next_validators.hash(),
+            consensus_hash=self.consensus_params.hash(),
+            app_hash=self.app_hash,
+            last_results_hash=self.last_results_hash,
+            evidence_hash=merkle.hash_from_byte_slices([]),
+            proposer_address=proposer_address,
+        )
+        return Block(header=header, data=data, last_commit=last_commit)
+
+
+class StateStore:
+    """Persistent state (reference state/store.go): the current State plus
+    per-height FinalizeBlock responses and validator sets."""
+
+    _KEY_STATE = b"statestore:state"
+
+    def __init__(self, db):
+        self._db = db
+
+    def save(self, state: State) -> None:
+        self._db.set(self._KEY_STATE, _state_to_json(state))
+        # index validator sets by height for light client / evidence lookups
+        self._db.set(b"vals:" + (state.last_block_height + 1).to_bytes(8, "big"),
+                     _valset_to_json(state.validators))
+
+    def load(self) -> Optional[State]:
+        raw = self._db.get(self._KEY_STATE)
+        return _state_from_json(raw) if raw is not None else None
+
+    def load_validators(self, height: int) -> Optional[ValidatorSet]:
+        raw = self._db.get(b"vals:" + height.to_bytes(8, "big"))
+        return _valset_from_json(raw) if raw is not None else None
+
+    def save_finalize_block_response(self, height: int, resp_bytes: bytes
+                                     ) -> None:
+        self._db.set(b"abci:" + height.to_bytes(8, "big"), resp_bytes)
+
+    def load_finalize_block_response(self, height: int) -> Optional[bytes]:
+        return self._db.get(b"abci:" + height.to_bytes(8, "big"))
+
+
+def _valset_to_json(vs: ValidatorSet) -> bytes:
+    prop = vs.get_proposer()
+    return json.dumps({
+        "validators": [
+            {"pub_key": v.pub_key.bytes_().hex(),
+             "power": v.voting_power,
+             "priority": v.proposer_priority}
+            for v in vs.validators],
+        "proposer": prop.pub_key.bytes_().hex() if prop else None,
+    }).encode()
+
+
+def _valset_from_json(raw: bytes) -> ValidatorSet:
+    d = json.loads(raw)
+    vals = [Validator(Ed25519PubKey(bytes.fromhex(v["pub_key"])),
+                      v["power"], v["priority"])
+            for v in d["validators"]]
+    vs = ValidatorSet.__new__(ValidatorSet)
+    vs.validators = vals
+    vs._by_address = {v.address: i for i, v in enumerate(vals)}
+    vs._total = None
+    vs.proposer = None
+    if d["proposer"] is not None:
+        addr = Ed25519PubKey(bytes.fromhex(d["proposer"])).address()
+        idx = vs._by_address.get(addr)
+        vs.proposer = vals[idx] if idx is not None else None
+    return vs
+
+
+def _state_to_json(s: State) -> bytes:
+    return json.dumps({
+        "chain_id": s.chain_id,
+        "initial_height": s.initial_height,
+        "last_block_height": s.last_block_height,
+        "last_block_id": {
+            "hash": s.last_block_id.hash.hex(),
+            "total": s.last_block_id.parts.total,
+            "parts_hash": s.last_block_id.parts.hash.hex()},
+        "last_block_time": [s.last_block_time.seconds,
+                            s.last_block_time.nanos],
+        "validators": _valset_to_json(s.validators).decode(),
+        "next_validators": _valset_to_json(s.next_validators).decode(),
+        "last_validators": _valset_to_json(s.last_validators).decode(),
+        "last_height_validators_changed": s.last_height_validators_changed,
+        "last_results_hash": s.last_results_hash.hex(),
+        "app_hash": s.app_hash.hex(),
+        "version_block": s.version_block,
+        "version_app": s.version_app,
+        "consensus_params": {
+            "max_block_bytes": s.consensus_params.max_block_bytes,
+            "max_gas": s.consensus_params.max_gas,
+            "evidence_max_age_num_blocks":
+                s.consensus_params.evidence_max_age_num_blocks,
+            "evidence_max_age_seconds":
+                s.consensus_params.evidence_max_age_seconds,
+            "evidence_max_bytes": s.consensus_params.evidence_max_bytes,
+            "pbts_enable_height": s.consensus_params.pbts_enable_height,
+        },
+    }).encode()
+
+
+def _state_from_json(raw: bytes) -> State:
+    from ..types.block import PartSetHeader
+    d = json.loads(raw)
+    bid = BlockID(bytes.fromhex(d["last_block_id"]["hash"]),
+                  PartSetHeader(d["last_block_id"]["total"],
+                                bytes.fromhex(d["last_block_id"]["parts_hash"])))
+    return State(
+        chain_id=d["chain_id"],
+        initial_height=d["initial_height"],
+        last_block_height=d["last_block_height"],
+        last_block_id=bid,
+        last_block_time=Timestamp(*d["last_block_time"]),
+        validators=_valset_from_json(d["validators"].encode()),
+        next_validators=_valset_from_json(d["next_validators"].encode()),
+        last_validators=_valset_from_json(d["last_validators"].encode()),
+        last_height_validators_changed=d["last_height_validators_changed"],
+        consensus_params=ConsensusParams(**d["consensus_params"]),
+        last_results_hash=bytes.fromhex(d["last_results_hash"]),
+        app_hash=bytes.fromhex(d["app_hash"]),
+        version_block=d["version_block"],
+        version_app=d["version_app"],
+    )

@@ -1,0 +1,140 @@
+"""Block store: blocks persisted as parts + meta + commits
+(reference store/store.go:194-331,527-559).
+
+Key layout (height big-endian so byte order == height order for scans):
+  H:<height>      -> block meta (block_id proto || header proto)
+  P:<height>:<i>  -> part bytes
+  C:<height>      -> canonical commit for height (block h+1's LastCommit)
+  SC:<height>     -> seen commit (the commit this node observed)
+  base / height   -> chain span markers
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..db.kv import KVStore
+from ..types import proto
+from ..types.block import Block, BlockID, Commit, Header, PartSet
+
+_KEY_BASE = b"blockstore:base"
+_KEY_HEIGHT = b"blockstore:height"
+
+
+def _h(prefix: bytes, height: int) -> bytes:
+    return prefix + height.to_bytes(8, "big")
+
+
+class BlockStore:
+    def __init__(self, db: KVStore):
+        self._db = db
+        self._lock = threading.RLock()
+        b = db.get(_KEY_BASE)
+        h = db.get(_KEY_HEIGHT)
+        self._base = int.from_bytes(b, "big") if b else 0
+        self._height = int.from_bytes(h, "big") if h else 0
+
+    def base(self) -> int:
+        with self._lock:
+            return self._base
+
+    def height(self) -> int:
+        with self._lock:
+            return self._height
+
+    def size(self) -> int:
+        with self._lock:
+            return self._height - self._base + 1 if self._height else 0
+
+    def save_block(self, block: Block, parts: PartSet,
+                   seen_commit: Commit) -> None:
+        """reference store/store.go:527 SaveBlock."""
+        height = block.header.height
+        with self._lock:
+            # idempotent for the current tip: a crash between save and
+            # state-apply means the same height is legitimately re-saved on
+            # retry (reference blocksync saves before applying,
+            # internal/blocksync/reactor.go:527-532)
+            if self._height and height not in (self._height, self._height + 1):
+                raise ValueError(
+                    f"non-contiguous save: have {self._height}, got {height}")
+            sets = []
+            meta = (proto.f_embed(1, BlockID(
+                        block.hash(), parts.header).encode())
+                    + proto.f_embed(2, block.header.encode()))
+            sets.append((_h(b"H:", height), meta))
+            for part in parts.parts:
+                sets.append((_h(b"P:", height) + part.index.to_bytes(4, "big"),
+                             part.bytes_))
+            # block h carries the canonical commit for h-1
+            if block.last_commit.height:
+                sets.append((_h(b"C:", height - 1),
+                             block.last_commit.encode()))
+            sets.append((_h(b"SC:", height), seen_commit.encode()))
+            new_base = self._base or height
+            sets.append((_KEY_BASE, new_base.to_bytes(8, "big")))
+            sets.append((_KEY_HEIGHT, height.to_bytes(8, "big")))
+            self._db.write_batch(sets)
+            self._base, self._height = new_base, height
+
+    def load_block(self, height: int) -> Optional[Block]:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        block_id, _ = meta
+        chunks = []
+        for i in range(block_id.parts.total if block_id.parts.total else 1):
+            p = self._db.get(_h(b"P:", height) + i.to_bytes(4, "big"))
+            if p is None:
+                return None
+            chunks.append(p)
+        return Block.decode(b"".join(chunks))
+
+    def load_block_meta(self, height: int
+                        ) -> Optional[tuple[BlockID, Header]]:
+        raw = self._db.get(_h(b"H:", height))
+        if raw is None:
+            return None
+        f = proto.parse_fields(raw)
+        return (BlockID.decode(proto.field_one(f, 1, b"")),
+                Header.decode(proto.field_one(f, 2, b"")))
+
+    def load_block_part(self, height: int, index: int) -> Optional[bytes]:
+        return self._db.get(_h(b"P:", height) + index.to_bytes(4, "big"))
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        """Canonical commit for `height` (from block height+1's LastCommit,
+        reference store/store.go LoadBlockCommit)."""
+        raw = self._db.get(_h(b"C:", height))
+        return Commit.decode(raw) if raw is not None else None
+
+    def load_seen_commit(self, height: int) -> Optional[Commit]:
+        raw = self._db.get(_h(b"SC:", height))
+        return Commit.decode(raw) if raw is not None else None
+
+    def prune_blocks(self, retain_height: int) -> int:
+        """Delete blocks below retain_height; returns pruned count
+        (reference store/store.go PruneBlocks)."""
+        with self._lock:
+            if retain_height > self._height + 1:
+                raise ValueError(
+                    f"cannot prune beyond height+1 ({self._height + 1}), "
+                    f"got {retain_height}")
+            if retain_height <= self._base:
+                return 0
+            pruned = 0
+            deletes = []
+            for h in range(self._base, min(retain_height, self._height + 1)):
+                meta = self.load_block_meta(h)
+                deletes.append(_h(b"H:", h))
+                deletes.append(_h(b"C:", h))
+                deletes.append(_h(b"SC:", h))
+                if meta:
+                    for i in range(meta[0].parts.total):
+                        deletes.append(_h(b"P:", h) + i.to_bytes(4, "big"))
+                pruned += 1
+            self._base = retain_height
+            self._db.write_batch(
+                [(_KEY_BASE, retain_height.to_bytes(8, "big"))], deletes)
+            return pruned

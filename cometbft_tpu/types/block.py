@@ -43,6 +43,11 @@ class PartSetHeader:
         """proto PartSetHeader (types.proto: total=1, hash=2)."""
         return proto.f_varint(1, self.total) + proto.f_bytes(2, self.hash)
 
+    @classmethod
+    def decode(cls, buf: bytes) -> "PartSetHeader":
+        f = proto.parse_fields(buf)
+        return cls(proto.field_one(f, 1, 0), proto.field_one(f, 2, b""))
+
 
 @dataclass(frozen=True)
 class BlockID:
@@ -71,6 +76,14 @@ class BlockID:
 
     def key(self) -> bytes:
         return self.hash + self.parts.hash + self.parts.total.to_bytes(4, "big")
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "BlockID":
+        f = proto.parse_fields(buf)
+        psh = proto.field_one(f, 2)
+        return cls(proto.field_one(f, 1, b""),
+                   PartSetHeader.decode(psh) if psh is not None
+                   else PartSetHeader())
 
 
 @dataclass(frozen=True)
@@ -105,6 +118,15 @@ class CommitSig:
                 + proto.f_bytes(2, self.validator_address)
                 + proto.f_embed(3, self.timestamp.encode())
                 + proto.f_bytes(4, self.signature))
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "CommitSig":
+        f = proto.parse_fields(buf)
+        ts = proto.field_one(f, 3)
+        return cls(proto.field_one(f, 1, 0),
+                   proto.field_one(f, 2, b""),
+                   Timestamp.decode(ts) if ts is not None else Timestamp(),
+                   proto.field_one(f, 4, b""))
 
     def validate_basic(self) -> None:
         if self.block_id_flag not in (BLOCK_ID_FLAG_ABSENT,
@@ -168,6 +190,15 @@ class Commit:
             out += proto.f_embed(4, cs.encode())
         return out
 
+    @classmethod
+    def decode(cls, buf: bytes) -> "Commit":
+        f = proto.parse_fields(buf)
+        bid = proto.field_one(f, 3)
+        return cls(proto.to_int64(proto.field_one(f, 1, 0)),
+                   proto.to_int64(proto.field_one(f, 2, 0)),
+                   BlockID.decode(bid) if bid is not None else BlockID(),
+                   [CommitSig.decode(b) for b in proto.field_all(f, 4)])
+
 
 @dataclass(frozen=True)
 class Header:
@@ -230,6 +261,30 @@ class Header:
                 + proto.f_bytes(13, self.evidence_hash)
                 + proto.f_bytes(14, self.proposer_address))
 
+    @classmethod
+    def decode(cls, buf: bytes) -> "Header":
+        f = proto.parse_fields(buf)
+        ver = proto.parse_fields(proto.field_one(f, 1, b""))
+        ts = proto.field_one(f, 4)
+        lbi = proto.field_one(f, 5)
+        return cls(
+            version_block=proto.field_one(ver, 1, 0),
+            version_app=proto.field_one(ver, 2, 0),
+            chain_id=proto.field_one(f, 2, b"").decode("utf-8"),
+            height=proto.to_int64(proto.field_one(f, 3, 0)),
+            time=Timestamp.decode(ts) if ts is not None else Timestamp(),
+            last_block_id=(BlockID.decode(lbi) if lbi is not None
+                           else BlockID()),
+            last_commit_hash=proto.field_one(f, 6, b""),
+            data_hash=proto.field_one(f, 7, b""),
+            validators_hash=proto.field_one(f, 8, b""),
+            next_validators_hash=proto.field_one(f, 9, b""),
+            consensus_hash=proto.field_one(f, 10, b""),
+            app_hash=proto.field_one(f, 11, b""),
+            last_results_hash=proto.field_one(f, 12, b""),
+            evidence_hash=proto.field_one(f, 13, b""),
+            proposer_address=proto.field_one(f, 14, b""))
+
     def validate_basic(self) -> None:
         if not self.chain_id or len(self.chain_id) > 50:
             raise ValueError("bad chain_id")
@@ -263,6 +318,11 @@ class Data:
             out += proto.f_bytes(1, t)
         return out
 
+    @classmethod
+    def decode(cls, buf: bytes) -> "Data":
+        f = proto.parse_fields(buf)
+        return cls(list(proto.field_all(f, 1)))
+
 
 @dataclass
 class Block:
@@ -282,6 +342,19 @@ class Block:
                + proto.f_embed(3, b""))  # evidence list (wired in later)
         out += proto.f_embed(4, self.last_commit.encode())
         return out
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Block":
+        f = proto.parse_fields(buf)
+        hdr = proto.field_one(f, 1)
+        if hdr is None:
+            raise ValueError("block without header")
+        data = proto.field_one(f, 2)
+        lc = proto.field_one(f, 4)
+        return cls(header=Header.decode(hdr),
+                   data=Data.decode(data) if data is not None else Data(),
+                   last_commit=Commit.decode(lc) if lc is not None
+                   else Commit())
 
     def make_part_set(self, part_size: int = BLOCK_PART_SIZE) -> "PartSet":
         return PartSet.from_data(self.encode(), part_size)

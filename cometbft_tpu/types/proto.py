@@ -87,6 +87,73 @@ def marshal_delimited(payload: bytes) -> bytes:
     return uvarint(len(payload)) + payload
 
 
+# --- wire decoding -----------------------------------------------------------
+
+def read_uvarint(buf: bytes, pos: int) -> tuple[int, int]:
+    """(value, new_pos); raises ValueError on truncation/overlong."""
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def to_int64(u: int) -> int:
+    """Interpret a uint64 wire value as int64 two's complement."""
+    return u - (1 << 64) if u >= (1 << 63) else u
+
+
+def parse_fields(buf: bytes) -> dict:
+    """Parse a proto message into {field_number: [values]} where a value is
+    an int (varint / fixed64 / fixed32, raw unsigned) or bytes
+    (length-delimited). Unknown wire types raise."""
+    fields: dict = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = read_uvarint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == _VARINT:
+            val, pos = read_uvarint(buf, pos)
+        elif wire == _FIX64:
+            if pos + 8 > n:
+                raise ValueError("truncated fixed64")
+            val = int.from_bytes(buf[pos:pos + 8], "little")
+            pos += 8
+        elif wire == _BYTES:
+            ln, pos = read_uvarint(buf, pos)
+            if pos + ln > n:
+                raise ValueError("truncated bytes field")
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:  # fixed32
+            if pos + 4 > n:
+                raise ValueError("truncated fixed32")
+            val = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def field_one(fields: dict, num: int, default=None):
+    vals = fields.get(num)
+    return vals[-1] if vals else default
+
+
+def field_all(fields: dict, num: int) -> list:
+    return fields.get(num, [])
+
+
 # --- google.protobuf.Timestamp ----------------------------------------------
 
 @dataclass(frozen=True, order=True)
@@ -104,6 +171,11 @@ class Timestamp:
         import time
         t = time.time_ns()
         return cls(t // 1_000_000_000, t % 1_000_000_000)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Timestamp":
+        f = parse_fields(buf)
+        return cls(to_int64(field_one(f, 1, 0)), to_int64(field_one(f, 2, 0)))
 
     def is_zero(self) -> bool:
         return self.seconds == 0 and self.nanos == 0

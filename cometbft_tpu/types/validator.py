@@ -186,3 +186,72 @@ class ValidatorSet:
         cp = self.copy()
         cp.increment_proposer_priority(times)
         return cp
+
+    # --- set updates (validator_set.go:594-666) -----------------------------
+
+    def update_with_change_set(self, changes: List[Validator]) -> None:
+        """Apply ABCI validator updates: power 0 removes, new validators
+        enter with priority -1.125*total (so re-bonding can't reset a
+        negative priority), then rescale/center/re-sort
+        (reference types/validator_set.go:479-666)."""
+        if not changes:
+            return
+        seen = set()
+        for c in changes:
+            if c.voting_power < 0:
+                raise ValueError("negative voting power")
+            if c.address in seen:
+                raise ValueError("duplicate address in changes")
+            seen.add(c.address)
+        updates = sorted((c for c in changes if c.voting_power > 0),
+                         key=lambda v: v.address)
+        deletes = [c for c in changes if c.voting_power == 0]
+
+        for d in deletes:
+            if not self.has_address(d.address):
+                raise ValueError("removing non-existent validator")
+        removed_power = sum(
+            self.get_by_address(d.address)[1].voting_power for d in deletes)
+
+        # total after updates, before removals (verifyUpdates)
+        delta = 0
+        for u in updates:
+            _, cur = self.get_by_address(u.address)
+            delta += u.voting_power - (cur.voting_power if cur else 0)
+        tvp_after_updates = self.total_voting_power() + delta
+        if tvp_after_updates - removed_power > MAX_TOTAL_VOTING_POWER:
+            raise ValueError("total voting power would exceed cap")
+
+        new_count = sum(1 for u in updates if not self.has_address(u.address))
+        survivors = len(self.validators) - len(deletes)
+        if new_count == 0 and survivors == 0:
+            raise ValueError("updates would result in empty set")
+
+        for u in updates:
+            _, cur = self.get_by_address(u.address)
+            if cur is None:
+                u.proposer_priority = -(tvp_after_updates
+                                        + (tvp_after_updates >> 3))
+            else:
+                u.proposer_priority = cur.proposer_priority
+
+        # apply updates then removals
+        by_addr = {v.address: v for v in self.validators}
+        for u in updates:
+            by_addr[u.address] = u.copy()
+        for d in deletes:
+            del by_addr[d.address]
+        self.validators = sorted(
+            by_addr.values(), key=lambda v: (-v.voting_power, v.address))
+        self._by_address = {v.address: i
+                            for i, v in enumerate(self.validators)}
+        self._total = None
+        self.total_voting_power()
+
+        self.rescale_priorities(
+            PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
+        self._shift_by_avg_proposer_priority()
+        if self.proposer is not None:
+            idx = self._by_address.get(self.proposer.address)
+            self.proposer = (self.validators[idx] if idx is not None
+                             else None)

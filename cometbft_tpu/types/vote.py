@@ -100,6 +100,23 @@ class Vote:
                + proto.f_bytes(10, self.extension_signature))
         return out
 
+    @classmethod
+    def decode(cls, buf: bytes) -> "Vote":
+        f = proto.parse_fields(buf)
+        bid = proto.field_one(f, 4)
+        ts = proto.field_one(f, 5)
+        return cls(
+            type_=proto.field_one(f, 1, 0),
+            height=proto.to_int64(proto.field_one(f, 2, 0)),
+            round=proto.to_int64(proto.field_one(f, 3, 0)),
+            block_id=BlockID.decode(bid) if bid is not None else BlockID(),
+            timestamp=Timestamp.decode(ts) if ts is not None else Timestamp(),
+            validator_address=proto.field_one(f, 6, b""),
+            validator_index=proto.to_int64(proto.field_one(f, 7, 0)),
+            signature=proto.field_one(f, 8, b""),
+            extension=proto.field_one(f, 9, b""),
+            extension_signature=proto.field_one(f, 10, b""))
+
 
 @dataclass
 class Proposal:

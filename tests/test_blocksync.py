@@ -1,0 +1,126 @@
+"""End-to-end slice: generated chain → block store + executor + blocksync
+reactor with cross-block tiled TPU verification (the north-star loop,
+reference internal/blocksync/reactor.go:429-547)."""
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.db.kv import MemDB, FileDB
+from cometbft_tpu.engine.blocksync import BlocksyncReactor
+from cometbft_tpu.engine.chain_gen import (
+    GeneratedChain, LocalChainSource, generate_chain)
+from cometbft_tpu.state.execution import BlockExecutor, BlockValidationError
+from cometbft_tpu.state.state import State, StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+
+
+CHAIN = generate_chain(n_blocks=12, n_validators=4, txs_per_block=2)
+
+
+def _fresh_node(chain: GeneratedChain, db=None):
+    app = KVStoreApplication()
+    app.init_chain(chain.chain_id, 1, [], b"")
+    db = db or MemDB()
+    store = BlockStore(db)
+    sstore = StateStore(db)
+    executor = BlockExecutor(app, state_store=sstore, block_store=store)
+    state = State.from_genesis(chain.genesis)
+    return app, store, sstore, executor, state
+
+
+def test_blocksync_catches_up():
+    app, store, sstore, executor, state = _fresh_node(CHAIN)
+    src = LocalChainSource(CHAIN)
+    reactor = BlocksyncReactor(executor, store, src, CHAIN.chain_id,
+                               tile_size=5, batch_size=64)
+    state = reactor.sync(state)
+    assert state.last_block_height == 12
+    assert reactor.stats.blocks_applied == 12
+    assert reactor.stats.tiles_flushed >= 2
+    # the app saw every tx
+    assert app.state["k12-0"] == "v12-0"
+    assert app.state["k1-1"] == "v1-1"
+    # store has the blocks and commits
+    assert store.height() == 12
+    blk = store.load_block(7)
+    assert blk is not None and blk.header.height == 7
+    assert store.load_block_commit(7).height == 7
+    assert store.load_seen_commit(12).height == 12
+    # persisted state round-trips
+    loaded = sstore.load()
+    assert loaded.last_block_height == 12
+    assert loaded.app_hash == state.app_hash
+    assert loaded.validators.hash() == state.validators.hash()
+
+
+def test_blocksync_rejects_corrupt_sig_then_recovers():
+    app, store, sstore, executor, state = _fresh_node(CHAIN)
+    # height 6's sealing commit lives in block 7's last_commit
+    src = LocalChainSource(CHAIN, corrupt_heights={7: "sig"})
+    reactor = BlocksyncReactor(executor, store, src, CHAIN.chain_id,
+                               tile_size=4, batch_size=64)
+    state = reactor.sync(state)
+    assert state.last_block_height == 12
+    assert src.banned, "corrupt peer was never banned"
+    assert 6 in src.banned or 7 in src.banned
+
+
+def test_blocksync_rejects_tampered_data():
+    app, store, sstore, executor, state = _fresh_node(CHAIN)
+    src = LocalChainSource(CHAIN, corrupt_heights={5: "data"})
+    reactor = BlocksyncReactor(executor, store, src, CHAIN.chain_id,
+                               tile_size=4, batch_size=64)
+    state = reactor.sync(state)
+    assert state.last_block_height == 12
+    assert 5 in src.banned
+
+
+def test_blocksync_exhausts_retries_on_persistent_corruption():
+    class StubbornSource(LocalChainSource):
+        def ban(self, height):
+            self.banned.append(height)  # keeps serving corrupt data
+
+    app, store, sstore, executor, state = _fresh_node(CHAIN)
+    src = StubbornSource(CHAIN, corrupt_heights={3: "sig"})
+    reactor = BlocksyncReactor(executor, store, src, CHAIN.chain_id,
+                               tile_size=4, batch_size=64, max_retries=2)
+    with pytest.raises(BlockValidationError):
+        reactor.sync(state)
+
+
+def test_blocksync_with_validator_set_change():
+    """Mid-chain validator power change: speculation must fall back to the
+    true set and still complete (the respeculation path)."""
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    new_key = Ed25519PrivKey(b"\x99" * 32)
+    val_tx = b"val:" + new_key.pub_key().bytes_().hex().encode() + b"!15"
+    chain = generate_chain(n_blocks=10, n_validators=4, seed=3,
+                           val_tx_heights={4: val_tx},
+                           extra_keys=[new_key])
+
+    app, store, sstore, executor, state = _fresh_node(chain)
+    src = LocalChainSource(chain)
+    reactor = BlocksyncReactor(executor, store, src, chain.chain_id,
+                               tile_size=8, batch_size=64)
+    state = reactor.sync(state)
+    assert state.last_block_height == 10
+    assert state.validators.has_address(new_key.pub_key().address())
+    assert reactor.stats.respeculations >= 1
+
+
+def test_blockstore_filedb_persistence(tmp_path):
+    db = FileDB(str(tmp_path / "blocks.db"))
+    app, store, sstore, executor, state = _fresh_node(CHAIN, db=db)
+    src = LocalChainSource(CHAIN)
+    reactor = BlocksyncReactor(executor, store, src, CHAIN.chain_id,
+                               tile_size=6, batch_size=64)
+    reactor.sync(state)
+    db.close()
+    # reopen: everything still there
+    db2 = FileDB(str(tmp_path / "blocks.db"))
+    store2 = BlockStore(db2)
+    assert store2.height() == 12
+    assert store2.load_block(3).header.height == 3
+    st2 = StateStore(db2).load()
+    assert st2.last_block_height == 12
+    db2.close()
