@@ -62,9 +62,10 @@ def _gen_signatures(n, n_validators=200, msg_len=122, seed=7):
 def main():
     import numpy as np
     import jax
-    from cometbft_tpu.ops.ed25519 import verify_kernel, prepare_batch
+    from cometbft_tpu.ops.ed25519 import (
+        verify_rlc_kernel, prepare_batch, make_rlc_coefficients)
 
-    batch = int(os.environ.get("BENCH_BATCH", "4096"))
+    batch = int(os.environ.get("BENCH_BATCH", "8192"))
     iters = int(os.environ.get("BENCH_ITERS", "4"))
 
     pubs, msgs, sigs = _gen_signatures(batch)
@@ -73,15 +74,19 @@ def main():
     dev = jax.devices()[0]
     pub, sig, hb, hn = (jax.device_put(x, dev) for x in (pub, sig, hb, hn))
 
-    out = verify_kernel(pub, sig, hb, hn)  # compile + warm
-    ok = np.asarray(out)
-    assert ok.all(), f"warmup verification failed: {ok.sum()}/{batch}"
+    # the production fast path: one random-linear-combination equation per
+    # tile (fresh coefficients every flush, as the verifier requires)
+    z = make_rlc_coefficients(batch)
+    bok, sok = verify_rlc_kernel(pub, sig, hb, hn, z)  # compile + warm
+    assert bool(bok) and np.asarray(sok).all(), "warmup verification failed"
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = verify_kernel(pub, sig, hb, hn)
+        z = make_rlc_coefficients(batch)
+        bok, out = verify_rlc_kernel(pub, sig, hb, hn, z)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
+    assert bool(bok)
 
     sigs_per_sec = batch * iters / dt
     print(json.dumps({

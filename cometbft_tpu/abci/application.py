@@ -37,10 +37,16 @@ class ExecTxResult:
         return self.code == CODE_TYPE_OK
 
     def encode(self) -> bytes:
-        """Deterministic encoding for last_results_hash (reference
-        types/results.go ABCIResults.Hash hashes code+data only)."""
+        """Deterministic encoding for last_results_hash: the reference
+        strips everything EXCEPT code, data, gas_wanted and gas_used
+        (abci/types/types.go:201-208 DeterministicExecTxResult; proto
+        fields 1, 2, 5, 6 of ExecTxResult) before merkle-hashing
+        (types/results.go NewResults/Hash)."""
         from ..types import proto
-        return proto.f_varint(1, self.code) + proto.f_bytes(2, self.data)
+        return (proto.f_varint(1, self.code)
+                + proto.f_bytes(2, self.data)
+                + proto.f_varint(5, self.gas_wanted)
+                + proto.f_varint(6, self.gas_used))
 
 
 @dataclass

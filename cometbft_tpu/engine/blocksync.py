@@ -17,6 +17,7 @@ block).
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Protocol, Sequence, Tuple
 
@@ -140,11 +141,15 @@ class BlocksyncReactor:
         self.tile_size = tile_size
         self.max_retries = max_retries
         self.stats = SyncStats()
-        # the first applied block's own last_commit predates the tile
-        # window, so it gets one synchronous full check; afterwards every
-        # block's last_commit was already tile-verified as its
-        # predecessor's seal
-        self._need_commit_check = True
+        # (height, sha256(commit.encode())) of the last tile-verified seal,
+        # keyed by the height of the block that CARRIES it as last_commit.
+        # Applying a block skips last-commit signature re-verification only
+        # when its last_commit bytes are the very bytes the tile verifier
+        # checked — enforced, not assumed: blocks at tile boundaries are
+        # re-fetched (possibly from another peer), so a digest mismatch
+        # falls back to the reference behavior of a full VerifyCommit
+        # (reference state/validation.go:94).
+        self._verified_seal: Optional[Tuple[int, bytes]] = None
 
     def sync(self, state: State, target_height: Optional[int] = None
              ) -> State:
@@ -238,9 +243,11 @@ class BlocksyncReactor:
                 raise BlockValidationError(
                     f"invalid commit for height {h} from peer")
 
+            lc_digest = hashlib.sha256(block.last_commit.encode()).digest()
+            seal_checked = self._verified_seal == (h, lc_digest)
             try:
                 self.executor.validate_block(
-                    state, block, check_commit=self._need_commit_check)
+                    state, block, check_commit=not seal_checked)
             except (BlockValidationError,
                     validation.CommitVerificationError) as exc:
                 self.source.ban(h)
@@ -252,7 +259,8 @@ class BlocksyncReactor:
             self.store.save_block(block, parts, seal_commit)
             state, _resp = self.executor.apply_block(
                 state, block_id, block, verified=True)
-            self._need_commit_check = False
+            self._verified_seal = (
+                h + 1, hashlib.sha256(seal_commit.encode()).digest())
             self.stats.blocks_applied += 1
             applied_any = True
             h += 1

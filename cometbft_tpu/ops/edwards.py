@@ -239,6 +239,50 @@ def straus_double_mul(s: jnp.ndarray, k: jnp.ndarray, a_table: Point
     return lax.fori_loop(1, 64, body, acc)
 
 
+def pt_tree_sum(p: Point) -> Point:
+    """Σ over the LEADING axis of a batched point, by pairwise halving.
+
+    coords (N, ..., NLIMBS) -> (..., NLIMBS). log2(N) rounds of complete
+    additions, each fully vectorized over the surviving lanes and any
+    trailing batch axes — the TPU-shaped inner loop of the batched MSM
+    (the role Pippenger bucket accumulation plays in curve25519-voi's
+    CPU batch verify, crypto/ed25519/ed25519.go:239-241)."""
+    n = p[0].shape[0]
+    while n > 1:
+        h = n // 2
+        s = pt_add(tuple(c[:h] for c in p), tuple(c[h:2 * h] for c in p))
+        if n % 2:
+            s = tuple(jnp.concatenate([cs, c[2 * h:]], axis=0)
+                      for cs, c in zip(s, p))
+        p = s
+        n = (n + 1) // 2
+    return tuple(c[0] for c in p)
+
+
+def horner_windows(w: Point) -> Point:
+    """Combine per-window sums W_j into Σ_j 16^j·W_j (radix-16 Horner).
+
+    coords (NWIN, NLIMBS), window 0 = least significant. NWIN-1 iterations
+    of 4 doublings + 1 add on a single point — O(windows), amortized to
+    nothing across the batch."""
+    rev = tuple(c[::-1] for c in w)
+
+    def step(acc, wpt):
+        acc = pt_double(pt_double(pt_double(pt_double(acc))))
+        return pt_add(acc, wpt), None
+
+    acc0 = tuple(c[0] for c in rev)
+    acc, _ = lax.scan(step, acc0, tuple(c[1:] for c in rev))
+    return acc
+
+
+def lookup_windows(table: Point, digits: jnp.ndarray) -> Point:
+    """Per-lane, per-window table selection: table coords (N, 16, NLIMBS),
+    digits (N, W) -> coords (N, W, NLIMBS)."""
+    idx = digits[..., None]
+    return tuple(jnp.take_along_axis(c, idx, axis=-2) for c in table)
+
+
 def scalar_mul(k: jnp.ndarray, p: Point) -> Point:
     """k*p for (..., 16) scalars and a batched point (windowed, radix-16)."""
     tab = window_table(p)

@@ -3,17 +3,18 @@
 L = 2^252 + 27742317777372353535851937790883648493 (~2^252.0).
 
 Same limb discipline as `field.py`: 16-bit little-endian limbs in int32,
-all products exact in uint32, every normalized value strictly < 2^16 per
-limb. Reduction is Barrett with b = 2^16, k = 16 limbs, which handles any
-input < 2^512 — exactly the range of a SHA-512 digest, the reference hot
-path's `k = SHA512(R||A||M) mod L` (reference: crypto/ed25519 verification
-via curve25519-voi; scalar semantics per RFC 8032 §5.1.7).
+LIMB AXIS LEADING (shape (nlimbs, *batch)), all products exact in uint32,
+every normalized value strictly < 2^16 per limb. Reduction is Barrett with
+b = 2^16, k = 16 limbs, which handles any input < 2^512 — exactly the
+range of a SHA-512 digest, the reference hot path's `k = SHA512(R||A||M)
+mod L` (reference: crypto/ed25519 verification via curve25519-voi; scalar
+semantics per RFC 8032 §5.1.7).
 
 Exports:
-- sc_reduce_wide: (..., 32 limbs) 512-bit -> (..., 16 limbs) mod L
-- sc_reduce:      (..., 16 limbs) 256-bit -> (..., 16 limbs) mod L
-- sc_mul / sc_mul_add: products mod L (for random-linear-combination
-  batch verification)
+- sc_reduce_wide: (32 limbs, ...) 512-bit -> (16 limbs, ...) mod L
+- sc_reduce:      (16 limbs, ...) 256-bit -> (16 limbs, ...) mod L
+- sc_mul / sc_mul_add / sc_dot_mod_l: products mod L (for
+  random-linear-combination batch verification)
 - sc_lt_l: canonicality check s < L (signature malleability gate,
   reference crypto/ed25519/ed25519.go ZIP-215 rule 1)
 - sc_nibbles: 64 radix-16 digits for windowed scalar multiplication
@@ -24,7 +25,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from .field import LIMB_BITS, MASK, spread_mul
+from .field import LIMB_BITS, MASK, bc, spread_mul
 
 L_INT = 2**252 + 27742317777372353535851937790883648493
 # Barrett constant mu = floor(b^(2k) / L) = floor(2^512 / L): 17 limbs.
@@ -42,67 +43,67 @@ MU_LIMBS = _limbs_const(MU_INT, 17)
 
 
 def _mp_carry(x: jnp.ndarray) -> jnp.ndarray:
-    """Plain carry-propagation pass; final carry must be representable in
-    the last limb's headroom (callers size outputs so it is zero)."""
-    c = jnp.zeros_like(x[..., 0])
+    """Plain carry-propagation pass over the leading limb axis; final
+    carry must be representable in the last limb's headroom (callers size
+    outputs so it is zero)."""
+    n = x.shape[0]
+    c = jnp.zeros_like(x[0])
     outs = []
-    n = x.shape[-1]
     for i in range(n):
-        t = x[..., i] + c
-        outs.append(t & MASK)
-        c = t >> LIMB_BITS
-    return jnp.stack(outs, axis=-1)
+        v = x[i] + c
+        outs.append(v & MASK)
+        c = v >> LIMB_BITS
+    return jnp.stack(outs)
 
 
 def _mp_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(..., la) x (..., lb) -> (..., la+lb) normalized limbs, via the
-    shared exact outer-product/spread-matmul kernel (field.spread_mul)."""
+    """(la, ...) x (lb, ...) -> (la+lb, ...) normalized limbs, via the
+    shared exact schoolbook kernel (field.spread_mul)."""
     return _mp_carry(spread_mul(a, b))
 
 
 def _mp_sub(a: jnp.ndarray, b: jnp.ndarray):
     """(a - b) over equal-length limbs; returns (diff mod b^n, borrow) with
     borrow 0 when a >= b else -1."""
-    c = jnp.zeros_like(a[..., 0])
+    n = a.shape[0]
+    c = jnp.zeros_like(a[0] - b[0])
     outs = []
-    n = a.shape[-1]
     for i in range(n):
-        t = a[..., i] - b[..., i] + c
-        outs.append(t & MASK)
-        c = t >> LIMB_BITS  # arithmetic shift: 0 or -1
-    return jnp.stack(outs, axis=-1), c
+        v = a[i] - b[i] + c
+        outs.append(v & MASK)
+        c = v >> LIMB_BITS  # arithmetic shift: 0 or -1
+    return jnp.stack(outs), c
 
 
 def _cond_sub_l(r: jnp.ndarray) -> jnp.ndarray:
-    lpad = jnp.zeros(r.shape[-1], dtype=jnp.int32).at[:16].set(
-        jnp.asarray(L_LIMBS))
-    diff, borrow = _mp_sub(r, jnp.broadcast_to(lpad, r.shape))
-    return jnp.where((borrow == 0)[..., None], diff, r)
+    lpad = np.zeros((r.shape[0],), dtype=np.int32)
+    lpad[:16] = L_LIMBS
+    diff, borrow = _mp_sub(r, jnp.broadcast_to(bc(lpad, r), r.shape))
+    return jnp.where((borrow == 0)[None], diff, r)
 
 
 def sc_reduce_wide(x: jnp.ndarray) -> jnp.ndarray:
-    """Reduce a 512-bit value (..., 32 limbs) mod L -> (..., 16 limbs).
+    """Reduce a 512-bit value (32 limbs, ...) mod L -> (16 limbs, ...).
 
     Barrett: q = floor(floor(x/b^15) * mu / b^17); r = x - q*L computed
     mod b^17; r < 3L so two conditional subtractions finish.
     """
-    assert x.shape[-1] == 32
-    q1 = x[..., 15:]                                   # 17 limbs
-    q2 = _mp_mul(q1, jnp.asarray(MU_LIMBS))            # 34 limbs
-    q3 = q2[..., 17:]                                  # 17 limbs
-    r1 = x[..., :17]                                   # x mod b^17
-    r2 = _mp_mul(q3, jnp.asarray(L_LIMBS))[..., :17]   # q3*L mod b^17
+    assert x.shape[0] == 32
+    q1 = x[15:]                                        # 17 limbs
+    q2 = _mp_mul(q1, bc(MU_LIMBS, q1))                 # 34 limbs
+    q3 = q2[17:]                                       # 17 limbs
+    r1 = x[:17]                                        # x mod b^17
+    r2 = _mp_mul(q3, bc(L_LIMBS, q3))[:17]             # q3*L mod b^17
     r, _ = _mp_sub(r1, r2)                             # exact: r < 3L < b^17
     r = _cond_sub_l(r)
     r = _cond_sub_l(r)
-    return r[..., :16]
+    return r[:16]
 
 
 def sc_reduce(x: jnp.ndarray) -> jnp.ndarray:
-    """Reduce a 256-bit value (..., 16 limbs) mod L."""
-    assert x.shape[-1] == 16
-    wide = jnp.concatenate(
-        [x, jnp.zeros_like(x)], axis=-1)
+    """Reduce a 256-bit value (16 limbs, ...) mod L."""
+    assert x.shape[0] == 16
+    wide = jnp.concatenate([x, jnp.zeros_like(x)], axis=0)
     return sc_reduce_wide(wide)
 
 
@@ -114,11 +115,11 @@ def sc_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def sc_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """(a + b) mod L for reduced scalars (sum < 2L -> one cond-subtract
     after a 17-limb carry)."""
-    s = jnp.concatenate([a, jnp.zeros_like(a[..., :1])], axis=-1)
-    t = jnp.concatenate([b, jnp.zeros_like(b[..., :1])], axis=-1)
+    s = jnp.concatenate([a, jnp.zeros_like(a[:1])], axis=0)
+    t = jnp.concatenate([b, jnp.zeros_like(b[:1])], axis=0)
     r = _mp_carry(s + t)
     r = _cond_sub_l(r)
-    return r[..., :16]
+    return r[:16]
 
 
 def sc_mul_add(a: jnp.ndarray, b: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
@@ -126,40 +127,61 @@ def sc_mul_add(a: jnp.ndarray, b: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
     return sc_add(sc_mul(a, b), c)
 
 
+def sc_dot_mod_l(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(Σ_i a_i·b_i) mod L over the TRAILING batch axis: a (la, N),
+    b (lb, N), la+lb <= 30 -> (16,) reduced limbs.
+
+    The RLC accumulator Σ z_i·s_i computed WITHOUT per-lane modular
+    reduction: carry each product exactly, integer-sum across lanes
+    (limb sums < N·2^16 — int32-safe for N <= 2^15), one Barrett
+    reduction at the end. One reduction per batch instead of N."""
+    n = a.shape[-1]
+    la, lb = a.shape[0], b.shape[0]
+    assert la + lb <= 30 and n <= (1 << 15), (la, lb, n)
+    prod = _mp_carry(spread_mul(a, b))                 # (la+lb, N) < 2^16
+    tot = jnp.sum(prod, axis=-1)                       # (la+lb,) < N*2^16
+    wide = jnp.concatenate(
+        [tot, jnp.zeros((32 - la - lb,), dtype=tot.dtype)], axis=0)
+    return sc_reduce_wide(_mp_carry(wide))
+
+
 def sc_lt_l(x: jnp.ndarray) -> jnp.ndarray:
-    """x < L for a 256-bit value (..., 16 limbs) -> bool (...,).
+    """x < L for a 256-bit value (16 limbs, ...) -> bool (...,).
 
     The ZIP-215 s-canonicality gate (signatures with s >= L are rejected
     unconditionally, reference types/validation semantics)."""
-    _, borrow = _mp_sub(x, jnp.broadcast_to(jnp.asarray(L_LIMBS), x.shape))
+    _, borrow = _mp_sub(x, jnp.broadcast_to(bc(L_LIMBS, x), x.shape))
     return borrow != 0
 
 
 def sc_nibbles(x: jnp.ndarray) -> jnp.ndarray:
-    """(..., 16 limbs) -> (..., 64) radix-16 digits, little-endian."""
+    """(16 limbs, ...) -> (64, ...) radix-16 digits, little-endian,
+    digit axis leading."""
     shifts = jnp.arange(4, dtype=jnp.int32) * 4
-    nib = (x[..., :, None] >> shifts) & 0xF
-    return nib.reshape(*x.shape[:-1], 64)
+    sh = shifts.reshape(1, 4, *([1] * (x.ndim - 1)))
+    nib = (x[:, None] >> sh) & 0xF                     # (16, 4, ...)
+    return nib.reshape(64, *x.shape[1:])
 
 
 def sc_bits(x: jnp.ndarray) -> jnp.ndarray:
-    """(..., 16 limbs) -> (..., 256) bits, little-endian."""
+    """(16 limbs, ...) -> (256, ...) bits, little-endian, leading."""
     shifts = jnp.arange(LIMB_BITS, dtype=jnp.int32)
-    bits = (x[..., :, None] >> shifts) & 1
-    return bits.reshape(*x.shape[:-1], 256)
+    sh = shifts.reshape(1, LIMB_BITS, *([1] * (x.ndim - 1)))
+    bits = (x[:, None] >> sh) & 1
+    return bits.reshape(256, *x.shape[1:])
 
 
 def bytes_to_limbs(b: jnp.ndarray) -> jnp.ndarray:
-    """(..., 2n) uint8 little-endian -> (..., n) 16-bit limbs."""
-    n2 = b.shape[-1]
+    """(2n, ...) uint8 little-endian (byte axis leading) -> (n, ...)
+    16-bit limbs."""
+    n2 = b.shape[0]
     assert n2 % 2 == 0
-    b32 = b.astype(jnp.int32).reshape(*b.shape[:-1], n2 // 2, 2)
-    return b32[..., 0] | (b32[..., 1] << 8)
+    b32 = b.astype(jnp.int32).reshape(n2 // 2, 2, *b.shape[1:])
+    return b32[:, 0] | (b32[:, 1] << 8)
 
 
 def limbs_to_bytes(x: jnp.ndarray) -> jnp.ndarray:
-    """(..., n) 16-bit limbs -> (..., 2n) uint8 little-endian."""
+    """(n, ...) 16-bit limbs -> (2n, ...) uint8 little-endian, leading."""
     lo = (x & 0xFF).astype(jnp.uint8)
     hi = ((x >> 8) & 0xFF).astype(jnp.uint8)
-    return jnp.stack([lo, hi], axis=-1).reshape(*x.shape[:-1],
-                                                2 * x.shape[-1])
+    return jnp.stack([lo, hi], axis=1).reshape(2 * x.shape[0], *x.shape[1:])

@@ -29,15 +29,14 @@ class ConsensusParams:
     pbts_enable_height: int = 0
 
     def hash(self) -> bytes:
-        """Deterministic digest (reference HashConsensusParams hashes the
-        proto; ours hashes our own canonical encoding — node-local, not
-        wire-normative)."""
+        """Wire-normative digest: sha256 over proto(HashedParams) which
+        holds ONLY {1: block_max_bytes, 2: block_max_gas} (reference
+        types/params.go:383-401, proto/cometbft/types/v1/params.proto:88).
+        consensus_hash sits inside the signed header, so this must match
+        the reference byte-for-byte."""
         import hashlib
         enc = (proto.f_varint(1, self.max_block_bytes)
-               + proto.f_varint(2, self.max_gas & 0xFFFFFFFFFFFFFFFF)
-               + proto.f_varint(3, self.evidence_max_age_num_blocks)
-               + proto.f_varint(4, self.evidence_max_age_seconds)
-               + proto.f_varint(5, self.evidence_max_bytes))
+               + proto.f_varint(2, self.max_gas))
         return hashlib.sha256(enc).digest()
 
 

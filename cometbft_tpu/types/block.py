@@ -46,7 +46,7 @@ class PartSetHeader:
     @classmethod
     def decode(cls, buf: bytes) -> "PartSetHeader":
         f = proto.parse_fields(buf)
-        return cls(proto.field_one(f, 1, 0), proto.field_one(f, 2, b""))
+        return cls(proto.field_int(f, 1, 0), proto.field_bytes(f, 2, b""))
 
 
 @dataclass(frozen=True)
@@ -80,8 +80,8 @@ class BlockID:
     @classmethod
     def decode(cls, buf: bytes) -> "BlockID":
         f = proto.parse_fields(buf)
-        psh = proto.field_one(f, 2)
-        return cls(proto.field_one(f, 1, b""),
+        psh = proto.field_bytes(f, 2, None)
+        return cls(proto.field_bytes(f, 1, b""),
                    PartSetHeader.decode(psh) if psh is not None
                    else PartSetHeader())
 
@@ -122,11 +122,11 @@ class CommitSig:
     @classmethod
     def decode(cls, buf: bytes) -> "CommitSig":
         f = proto.parse_fields(buf)
-        ts = proto.field_one(f, 3)
-        return cls(proto.field_one(f, 1, 0),
-                   proto.field_one(f, 2, b""),
+        ts = proto.field_bytes(f, 3, None)
+        return cls(proto.field_int(f, 1, 0),
+                   proto.field_bytes(f, 2, b""),
                    Timestamp.decode(ts) if ts is not None else Timestamp(),
-                   proto.field_one(f, 4, b""))
+                   proto.field_bytes(f, 4, b""))
 
     def validate_basic(self) -> None:
         if self.block_id_flag not in (BLOCK_ID_FLAG_ABSENT,
@@ -193,11 +193,12 @@ class Commit:
     @classmethod
     def decode(cls, buf: bytes) -> "Commit":
         f = proto.parse_fields(buf)
-        bid = proto.field_one(f, 3)
-        return cls(proto.to_int64(proto.field_one(f, 1, 0)),
-                   proto.to_int64(proto.field_one(f, 2, 0)),
+        bid = proto.field_bytes(f, 3, None)
+        return cls(proto.to_int64(proto.field_int(f, 1, 0)),
+                   proto.to_int64(proto.field_int(f, 2, 0)),
                    BlockID.decode(bid) if bid is not None else BlockID(),
-                   [CommitSig.decode(b) for b in proto.field_all(f, 4)])
+                   [CommitSig.decode(b)
+                    for b in proto.field_all_bytes(f, 4)])
 
 
 @dataclass(frozen=True)
@@ -264,26 +265,30 @@ class Header:
     @classmethod
     def decode(cls, buf: bytes) -> "Header":
         f = proto.parse_fields(buf)
-        ver = proto.parse_fields(proto.field_one(f, 1, b""))
-        ts = proto.field_one(f, 4)
-        lbi = proto.field_one(f, 5)
+        ver = proto.parse_fields(proto.field_bytes(f, 1, b""))
+        ts = proto.field_bytes(f, 4, None)
+        lbi = proto.field_bytes(f, 5, None)
+        try:
+            chain_id = proto.field_bytes(f, 2, b"").decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise ValueError(f"chain_id not utf-8: {e}") from None
         return cls(
-            version_block=proto.field_one(ver, 1, 0),
-            version_app=proto.field_one(ver, 2, 0),
-            chain_id=proto.field_one(f, 2, b"").decode("utf-8"),
-            height=proto.to_int64(proto.field_one(f, 3, 0)),
+            version_block=proto.field_int(ver, 1, 0),
+            version_app=proto.field_int(ver, 2, 0),
+            chain_id=chain_id,
+            height=proto.to_int64(proto.field_int(f, 3, 0)),
             time=Timestamp.decode(ts) if ts is not None else Timestamp(),
             last_block_id=(BlockID.decode(lbi) if lbi is not None
                            else BlockID()),
-            last_commit_hash=proto.field_one(f, 6, b""),
-            data_hash=proto.field_one(f, 7, b""),
-            validators_hash=proto.field_one(f, 8, b""),
-            next_validators_hash=proto.field_one(f, 9, b""),
-            consensus_hash=proto.field_one(f, 10, b""),
-            app_hash=proto.field_one(f, 11, b""),
-            last_results_hash=proto.field_one(f, 12, b""),
-            evidence_hash=proto.field_one(f, 13, b""),
-            proposer_address=proto.field_one(f, 14, b""))
+            last_commit_hash=proto.field_bytes(f, 6, b""),
+            data_hash=proto.field_bytes(f, 7, b""),
+            validators_hash=proto.field_bytes(f, 8, b""),
+            next_validators_hash=proto.field_bytes(f, 9, b""),
+            consensus_hash=proto.field_bytes(f, 10, b""),
+            app_hash=proto.field_bytes(f, 11, b""),
+            last_results_hash=proto.field_bytes(f, 12, b""),
+            evidence_hash=proto.field_bytes(f, 13, b""),
+            proposer_address=proto.field_bytes(f, 14, b""))
 
     def validate_basic(self) -> None:
         if not self.chain_id or len(self.chain_id) > 50:
@@ -321,7 +326,7 @@ class Data:
     @classmethod
     def decode(cls, buf: bytes) -> "Data":
         f = proto.parse_fields(buf)
-        return cls(list(proto.field_all(f, 1)))
+        return cls(proto.field_all_bytes(f, 1))
 
 
 @dataclass
@@ -346,11 +351,11 @@ class Block:
     @classmethod
     def decode(cls, buf: bytes) -> "Block":
         f = proto.parse_fields(buf)
-        hdr = proto.field_one(f, 1)
+        hdr = proto.field_bytes(f, 1, None)
         if hdr is None:
             raise ValueError("block without header")
-        data = proto.field_one(f, 2)
-        lc = proto.field_one(f, 4)
+        data = proto.field_bytes(f, 2, None)
+        lc = proto.field_bytes(f, 4, None)
         return cls(header=Header.decode(hdr),
                    data=Data.decode(data) if data is not None else Data(),
                    last_commit=Commit.decode(lc) if lc is not None

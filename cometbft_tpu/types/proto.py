@@ -150,17 +150,58 @@ def field_one(fields: dict, num: int, default=None):
     return vals[-1] if vals else default
 
 
+def field_int(fields: dict, num: int, default: int = 0) -> int:
+    """field_one that enforces a varint/fixed wire value. A peer encoding
+    the field with the wrong wire type gets ValueError — a decode failure —
+    instead of an int leaking into message constructors (decoders must
+    never crash the ingest loop with TypeError/AttributeError)."""
+    v = field_one(fields, num, default)
+    if not isinstance(v, int):
+        raise ValueError(f"field {num}: expected scalar, got bytes")
+    return v
+
+
+def field_bytes(fields: dict, num: int, default=b""):
+    """field_one that enforces a length-delimited wire value. A None
+    default passes through for optional embedded messages."""
+    v = field_one(fields, num, default)
+    if v is None:
+        return None
+    if not isinstance(v, (bytes, bytearray)):
+        raise ValueError(f"field {num}: expected bytes, got scalar")
+    return bytes(v)
+
+
 def field_all(fields: dict, num: int) -> list:
     return fields.get(num, [])
 
 
+def field_all_bytes(fields: dict, num: int) -> list:
+    vals = fields.get(num, [])
+    if any(not isinstance(v, (bytes, bytearray)) for v in vals):
+        raise ValueError(f"field {num}: expected bytes, got scalar")
+    return [bytes(v) for v in vals]
+
+
 # --- google.protobuf.Timestamp ----------------------------------------------
+
+# Go's zero time.Time (Jan 1, year 1, UTC) as Unix seconds. gogoproto's
+# stdtime marshals the zero time as Timestamp{seconds: -62135596800}, NOT
+# as an empty message — absent CommitSigs carry zero timestamps (reference
+# types/block.go:612), so this sentinel is wire-normative for Commit.hash()
+# and every header hash above it.
+GO_ZERO_SECONDS = -62135596800
+
 
 @dataclass(frozen=True, order=True)
 class Timestamp:
     """(seconds, nanos) since epoch, UTC — the canonical time form
-    (reference types/canonical.go:80-86 forces UTC)."""
-    seconds: int = 0
+    (reference types/canonical.go:80-86 forces UTC).
+
+    The default value is Go's ZERO time (year 1), not the Unix epoch, so
+    that default-constructed timestamps encode byte-identically to the
+    reference's zero time.Time."""
+    seconds: int = GO_ZERO_SECONDS
     nanos: int = 0
 
     def encode(self) -> bytes:
@@ -175,10 +216,10 @@ class Timestamp:
     @classmethod
     def decode(cls, buf: bytes) -> "Timestamp":
         f = parse_fields(buf)
-        return cls(to_int64(field_one(f, 1, 0)), to_int64(field_one(f, 2, 0)))
+        return cls(to_int64(field_int(f, 1, 0)), to_int64(field_int(f, 2, 0)))
 
     def is_zero(self) -> bool:
-        return self.seconds == 0 and self.nanos == 0
+        return self.seconds == GO_ZERO_SECONDS and self.nanos == 0
 
 
 # --- canonical messages (proto/cometbft/types/v1/canonical.proto) -----------

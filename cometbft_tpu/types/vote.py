@@ -39,6 +39,21 @@ class Vote:
     def is_nil(self) -> bool:
         return self.block_id.is_nil()
 
+    def commit_sig(self) -> "CommitSig":
+        """Vote -> CommitSig (reference types/vote.go CommitSig); callers
+        map a missing vote to CommitSig.absent()."""
+        from .block import (CommitSig, BLOCK_ID_FLAG_COMMIT,
+                            BLOCK_ID_FLAG_NIL)
+        if self.block_id.is_complete():
+            flag = BLOCK_ID_FLAG_COMMIT
+        elif self.block_id.is_nil():
+            flag = BLOCK_ID_FLAG_NIL
+        else:
+            raise ValueError(f"vote has neither nil nor complete blockID: "
+                             f"{self.block_id}")
+        return CommitSig(flag, self.validator_address, self.timestamp,
+                         self.signature)
+
     def sign_bytes(self, chain_id: str) -> bytes:
         """Varint-length-prefixed canonical proto (types/vote.go:142-158)."""
         return proto.marshal_delimited(proto.canonical_vote(
@@ -103,19 +118,19 @@ class Vote:
     @classmethod
     def decode(cls, buf: bytes) -> "Vote":
         f = proto.parse_fields(buf)
-        bid = proto.field_one(f, 4)
-        ts = proto.field_one(f, 5)
+        bid = proto.field_bytes(f, 4, None)
+        ts = proto.field_bytes(f, 5, None)
         return cls(
-            type_=proto.field_one(f, 1, 0),
-            height=proto.to_int64(proto.field_one(f, 2, 0)),
-            round=proto.to_int64(proto.field_one(f, 3, 0)),
+            type_=proto.field_int(f, 1, 0),
+            height=proto.to_int64(proto.field_int(f, 2, 0)),
+            round=proto.to_int64(proto.field_int(f, 3, 0)),
             block_id=BlockID.decode(bid) if bid is not None else BlockID(),
             timestamp=Timestamp.decode(ts) if ts is not None else Timestamp(),
-            validator_address=proto.field_one(f, 6, b""),
-            validator_index=proto.to_int64(proto.field_one(f, 7, 0)),
-            signature=proto.field_one(f, 8, b""),
-            extension=proto.field_one(f, 9, b""),
-            extension_signature=proto.field_one(f, 10, b""))
+            validator_address=proto.field_bytes(f, 6, b""),
+            validator_index=proto.to_int64(proto.field_int(f, 7, 0)),
+            signature=proto.field_bytes(f, 8, b""),
+            extension=proto.field_bytes(f, 9, b""),
+            extension_signature=proto.field_bytes(f, 10, b""))
 
 
 @dataclass

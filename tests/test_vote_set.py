@@ -1,0 +1,173 @@
+"""VoteSet 2/3-majority accounting + BitArray
+(reference types/vote_set_test.go scenarios, internal/bits/bit_array_test.go).
+"""
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.proto import Timestamp
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import Vote, PREVOTE_TYPE, PRECOMMIT_TYPE
+from cometbft_tpu.types.vote_set import (
+    VoteSet, ErrVoteConflictingVotes, ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorAddress, ErrVoteInvalidValidatorIndex,
+    ErrVoteNonDeterministicSignature, ErrVoteUnexpectedStep, VoteError)
+
+CHAIN = "test-vote-set"
+
+
+def _fixture(n=10, power=1):
+    keys = [Ed25519PrivKey(bytes([i + 1]) * 32) for i in range(n)]
+    vals = [Validator(k.pub_key(), power) for k in keys]
+    vs = ValidatorSet(vals)
+    # keys indexed to match the sorted validator order
+    by_addr = {k.pub_key().address(): k for k in keys}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return vs, ordered
+
+
+def _block_id(tag: bytes = b"A") -> BlockID:
+    return BlockID((tag * 32)[:32], PartSetHeader(1, (b"p" + tag * 31)[:32]))
+
+
+def _signed_vote(key, idx, type_=PREVOTE_TYPE, height=1, round_=0,
+                 block_id=None, ts=None):
+    v = Vote(type_=type_, height=height, round=round_,
+             block_id=block_id if block_id is not None else BlockID(),
+             timestamp=ts or Timestamp(1_700_000_000, 0),
+             validator_address=key.pub_key().address(),
+             validator_index=idx)
+    v.signature = key.sign(v.sign_bytes(CHAIN))
+    return v
+
+
+def test_bit_array_basics():
+    ba = BitArray(10)
+    assert ba.is_empty() and not ba.is_full()
+    assert ba.set_index(3, True) and ba.get_index(3)
+    assert not ba.set_index(10, True)  # out of range
+    assert ba.ones() == [3]
+    other = BitArray(10)
+    other.set_index(3, True)
+    other.set_index(7, True)
+    assert ba.or_(other).ones() == [3, 7]
+    assert ba.and_(other).ones() == [3]
+    assert other.sub(ba).ones() == [7]
+    assert ba.not_().num_true_bits() == 9
+    assert other.pick_random() in (3, 7)
+    # wire round-trip across the word boundary
+    big = BitArray(130)
+    for i in (0, 63, 64, 129):
+        big.set_index(i, True)
+    assert BitArray.from_words(130, big.to_words()) == big
+
+
+def test_add_vote_and_maj23():
+    vs, keys = _fixture(10)
+    voteset = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vs)
+    bid = _block_id()
+
+    assert voteset.two_thirds_majority() is None
+    # 6/10 power: no 2/3 yet (quorum = 10*2//3+1 = 7)
+    for i in range(6):
+        assert voteset.add_vote(_signed_vote(keys[i], i, block_id=bid))
+    assert voteset.two_thirds_majority() is None
+    assert not voteset.has_two_thirds_any()
+    # 7th crosses
+    assert voteset.add_vote(_signed_vote(keys[6], 6, block_id=bid))
+    assert voteset.two_thirds_majority() == bid
+    assert voteset.has_two_thirds_any()
+    assert voteset.bit_array().num_true_bits() == 7
+
+
+def test_duplicate_and_bad_votes():
+    vs, keys = _fixture(4)
+    voteset = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vs)
+    v = _signed_vote(keys[0], 0, block_id=_block_id())
+    assert voteset.add_vote(v)
+    assert voteset.add_vote(v) is False  # exact duplicate: no error
+
+    # same validator, same block, different signature bytes
+    forged = _signed_vote(keys[0], 0, block_id=_block_id(),
+                          ts=Timestamp(1_700_000_999, 0))
+    with pytest.raises(ErrVoteNonDeterministicSignature):
+        voteset.add_vote(forged)
+
+    with pytest.raises(ErrVoteUnexpectedStep):
+        voteset.add_vote(_signed_vote(keys[1], 1, height=2,
+                                      block_id=_block_id()))
+    with pytest.raises(ErrVoteInvalidValidatorIndex):
+        voteset.add_vote(_signed_vote(keys[1], 9, block_id=_block_id()))
+    # wrong address for claimed index
+    bad = _signed_vote(keys[1], 2, block_id=_block_id())
+    with pytest.raises(ErrVoteInvalidValidatorAddress):
+        voteset.add_vote(bad)
+    # bad signature
+    v3 = _signed_vote(keys[3], 3, block_id=_block_id())
+    v3.signature = bytes(64)
+    with pytest.raises(ErrVoteInvalidSignature):
+        voteset.add_vote(v3)
+
+
+def test_conflicting_votes_tracked_only_with_peer_claim():
+    """reference TestVoteSet_Conflicts: a conflicting vote is dropped
+    unless a peer registered the block via SetPeerMaj23."""
+    vs, keys = _fixture(4)
+    voteset = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vs)
+    bid_a, bid_b = _block_id(b"A"), _block_id(b"B")
+
+    assert voteset.add_vote(_signed_vote(keys[0], 0, block_id=bid_a))
+    # conflict, block B untracked -> raises, not added
+    with pytest.raises(ErrVoteConflictingVotes) as ei:
+        voteset.add_vote(_signed_vote(keys[0], 0, block_id=bid_b))
+    assert ei.value.added is False
+    assert ei.value.vote_a.block_id == bid_a
+    assert ei.value.vote_b.block_id == bid_b
+
+    # peer claims maj23 for B: now the conflicting vote is retained
+    voteset.set_peer_maj23("peer1", bid_b)
+    with pytest.raises(ErrVoteConflictingVotes) as ei:
+        voteset.add_vote(_signed_vote(keys[0], 0, block_id=bid_b))
+    assert ei.value.added is True
+    # second claim by the same peer for a different block is rejected
+    with pytest.raises(VoteError):
+        voteset.set_peer_maj23("peer1", bid_a)
+
+    # B accumulates quorum from the others despite key0's canonical A vote
+    for i in range(1, 4):
+        assert voteset.add_vote(_signed_vote(keys[i], i, block_id=bid_b))
+    assert voteset.two_thirds_majority() == bid_b
+    # key0's conflicting B vote was copied into the canonical list
+    assert voteset.get_by_index(0).block_id == bid_b
+
+
+def test_make_commit():
+    vs, keys = _fixture(4)
+    voteset = VoteSet(CHAIN, 3, 1, PRECOMMIT_TYPE, vs)
+    bid = _block_id()
+    with pytest.raises(VoteError):
+        voteset.make_commit()  # no maj23 yet
+    for i in range(3):
+        voteset.add_vote(_signed_vote(keys[i], i, PRECOMMIT_TYPE,
+                                      height=3, round_=1, block_id=bid))
+    commit = voteset.make_commit()
+    assert commit.height == 3 and commit.round == 1
+    assert commit.block_id == bid
+    assert len(commit.signatures) == 4
+    assert commit.signatures[3].absent_()
+    assert sum(1 for cs in commit.signatures if cs.for_block()) == 3
+    # the produced commit passes full commit verification
+    from cometbft_tpu.types import validation
+    validation.verify_commit(CHAIN, vs, bid, 3, commit)
+
+
+def test_nil_votes_count_toward_any_not_block():
+    vs, keys = _fixture(4)
+    voteset = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vs)
+    for i in range(3):
+        voteset.add_vote(_signed_vote(keys[i], i, PRECOMMIT_TYPE))  # nil
+    assert voteset.has_two_thirds_any()
+    assert voteset.two_thirds_majority() == BlockID()  # nil maj23 latched
+    assert voteset.is_commit()

@@ -1,0 +1,135 @@
+"""Microbenchmark the ed25519 kernel stages on the current default device.
+
+Chains K repetitions of each op inside one jit (scan with carry) so
+per-dispatch overhead and fusion behave as in the real kernel, then
+reports per-call time. Run on TPU: `python tools/profile_ops.py`.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cometbft_tpu.ops import edwards as ed
+from cometbft_tpu.ops import field as fe
+from cometbft_tpu.ops.scalar import sc_nibbles, sc_mul
+from cometbft_tpu.ops.sha512 import sha512_blocks
+
+N = int(os.environ.get("PROF_N", "4096"))
+K = int(os.environ.get("PROF_K", "32"))
+
+
+def timeit(name, fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:28s} {best*1e3:9.2f} ms total  {best*1e6/K:9.1f} us/call")
+    return out
+
+
+def chain(opfn):
+    """jit a scan of K sequential applications of opfn on a Point carry."""
+    @jax.jit
+    def run(p):
+        def step(c, _):
+            return opfn(c), None
+        c, _ = lax.scan(step, p, None, length=K)
+        return c
+    return run
+
+
+def main():
+    rng = np.random.default_rng(0)
+    limbs = lambda *s: jnp.asarray(
+        rng.integers(0, 1 << 16, size=(*s, 16), dtype=np.int32))
+    print(f"device={jax.devices()[0].platform} N={N} K={K}")
+
+    pt = (limbs(N), limbs(N), limbs(N), limbs(N))
+
+    # fe_mul chained
+    @jax.jit
+    def mulchain(a, b):
+        def step(c, _):
+            return fe.fe_mul(c, b), None
+        c, _ = lax.scan(step, a, None, length=K)
+        return c
+    timeit("fe_mul (N)", mulchain, limbs(N), limbs(N))
+
+    # fe_carry chained
+    @jax.jit
+    def carrychain(a):
+        def step(c, _):
+            return fe.fe_carry(c + 7), None
+        c, _ = lax.scan(step, a, None, length=K)
+        return c
+    timeit("fe_carry (N)", carrychain, limbs(N))
+
+    timeit("pt_add (N)", chain(lambda p: ed.pt_add(p, pt)), pt)
+    timeit("pt_double (N)", chain(ed.pt_double), pt)
+
+    # decompress x10
+    enc = jnp.asarray(rng.integers(0, 256, size=(N, 32), dtype=np.uint8))
+    @jax.jit
+    def dec(e):
+        def step(c, _):
+            p, ok = ed.pt_decompress(e)
+            return c + p[0][..., 0] * ok, None
+        c, _ = lax.scan(step, jnp.zeros((N,), jnp.int32), None, length=4)
+        return c
+    K_save = K
+    globals()["K"] = 4
+    timeit("pt_decompress (N)", dec, enc)
+    globals()["K"] = 1
+
+    # window table build (1 call)
+    wt = jax.jit(lambda p: ed.window_table(p))
+    timeit("window_table (N)", wt, pt)
+
+    # straus (1 call)
+    s = limbs(N) & 0x0FFF
+    k = limbs(N) & 0x0FFF
+    @jax.jit
+    def straus(s, k, p):
+        tab = ed.window_table(p)
+        return ed.straus_double_mul(s, k, tab)
+    timeit("straus_full (N)", straus, s, k, pt)
+
+    # tree path: lookup + tree sum over N for 64 windows (1 call)
+    @jax.jit
+    def treepath(t_scalar, p):
+        tab = ed.window_table(p)
+        sel = ed.lookup_windows(tab, sc_nibbles(t_scalar))
+        return ed.pt_tree_sum(sel)
+    timeit("tab+lookup+tree64 (N)", treepath, k, pt)
+
+    # horner (1 call)
+    w64 = tuple(limbs(64) for _ in range(4))
+    timeit("horner64 (1)", jax.jit(ed.horner_windows), w64)
+
+    # sha512, 2 blocks (1 call)
+    hb = jnp.asarray(rng.integers(0, 256, size=(N, 2, 128), dtype=np.uint8))
+    hn = jnp.full((N,), 2, dtype=np.int32)
+    timeit("sha512 2blk (N)", jax.jit(sha512_blocks), hb, hn)
+
+    # sc_mul (1 call)
+    timeit("sc_mul (N)", jax.jit(sc_mul), s, k)
+    globals()["K"] = K_save
+
+
+if __name__ == "__main__":
+    main()
