@@ -45,15 +45,20 @@ def verify_core(pub: jnp.ndarray, sig: jnp.ndarray,
     hblocks:  (N, B, 128) uint8 SHA-512-padded R||A||M blocks
     hnblocks: (N,) int32 live block counts
     returns:  (N,) bool validity
+
+    Host-facing arrays are batch-leading; the kernel transposes once at
+    the boundary to the device-native byte/limb-leading layout (batch on
+    the minor/lane axis — see field.py's layout rationale).
     """
-    r_enc, s_enc = sig[..., :32], sig[..., 32:]
+    sig_b = jnp.moveaxis(sig, -1, 0)                   # (64, N)
+    r_enc, s_enc = sig_b[:32], sig_b[32:]
     s = bytes_to_limbs(s_enc.astype(jnp.int32))
     s_ok = sc_lt_l(s)
 
-    a_pt, a_ok = ed.pt_decompress(pub, zip215=zip215)
+    a_pt, a_ok = ed.pt_decompress(jnp.moveaxis(pub, -1, 0), zip215=zip215)
     r_pt, r_ok = ed.pt_decompress(r_enc, zip215=zip215)
 
-    digest = sha512_blocks(hblocks, hnblocks)
+    digest = jnp.moveaxis(sha512_blocks(hblocks, hnblocks), -1, 0)
     k = sc_reduce_wide(bytes_to_limbs(digest.astype(jnp.int32)))
 
     # [s]B + [k](-A), then subtract R, then clear the cofactor
@@ -83,7 +88,8 @@ def verify_rlc_core(pub: jnp.ndarray, sig: jnp.ndarray,
     with z_i 128-bit random coefficients (soundness 2^-128, matching
     voi's batch semantics — cofactored, ZIP-215 compatible).
 
-    pub/sig/hblocks/hnblocks as in `verify_core`; z (N, 8) int32 limbs.
+    pub/sig/hblocks/hnblocks as in `verify_core` (batch-leading at the
+    host boundary); z (N, 8) int32 limbs.
     Returns (batch_ok scalar bool, struct_ok (N,) bool). Structurally
     invalid lanes (bad point/scalar encodings) have their z zeroed — they
     drop out of all three sums — and report False in struct_ok. If
@@ -97,33 +103,35 @@ def verify_rlc_core(pub: jnp.ndarray, sig: jnp.ndarray,
     doublings + 128 adds for per-lane Straus — and every stage is a wide
     vectorized op over the batch.
     """
-    r_enc, s_enc = sig[..., :32], sig[..., 32:]
-    s = bytes_to_limbs(s_enc.astype(jnp.int32))
+    sig_b = jnp.moveaxis(sig, -1, 0)                   # (64, N)
+    r_enc, s_enc = sig_b[:32], sig_b[32:]
+    s = bytes_to_limbs(s_enc.astype(jnp.int32))        # (16, N)
     s_ok = sc_lt_l(s)
 
-    a_pt, a_ok = ed.pt_decompress(pub, zip215=True)
+    a_pt, a_ok = ed.pt_decompress(jnp.moveaxis(pub, -1, 0), zip215=True)
     r_pt, r_ok = ed.pt_decompress(r_enc, zip215=True)
 
-    digest = sha512_blocks(hblocks, hnblocks)
-    k = sc_reduce_wide(bytes_to_limbs(digest.astype(jnp.int32)))
+    digest = jnp.moveaxis(sha512_blocks(hblocks, hnblocks), -1, 0)
+    k = sc_reduce_wide(bytes_to_limbs(digest.astype(jnp.int32)))  # (16, N)
 
-    struct_ok = s_ok & a_ok & r_ok
-    z = z * struct_ok[..., None].astype(z.dtype)       # drop bad lanes
+    struct_ok = s_ok & a_ok & r_ok                     # (N,)
+    zl = jnp.moveaxis(z, -1, 0)                        # (8, N) limb-leading
+    zl = zl * struct_ok[None].astype(zl.dtype)         # drop bad lanes
 
     # scalar side: S = Σ z_i s_i mod L; per-lane t_i = z_i k_i mod L
-    s_sum = sc_dot_mod_l(z, s)                          # (16,)
-    z16 = jnp.concatenate([z, jnp.zeros_like(z)], axis=-1)  # (N, 16)
-    t = sc_mul(z16, k)                                  # (N, 16)
+    s_sum = sc_dot_mod_l(zl, s)                         # (16,)
+    z16 = jnp.concatenate([zl, jnp.zeros_like(zl)], axis=0)  # (16, N)
+    t = sc_mul(z16, k)                                  # (16, N)
 
     # point side: per-window lane-trees over −R (z digits) and −A (t digits)
     tab_r = ed.window_table(ed.pt_neg(r_pt))
     tab_a = ed.window_table(ed.pt_neg(a_pt))
-    sel_r = ed.lookup_windows(tab_r, sc_nibbles(z16)[..., :ZWIN])
-    sel_a = ed.lookup_windows(tab_a, sc_nibbles(t))     # (N, 64, L)
-    w_r = ed.pt_tree_sum(sel_r)                         # (ZWIN, L)
-    w_a = ed.pt_tree_sum(sel_a)                         # (64, L)
-    lo = ed.pt_add(tuple(c[:ZWIN] for c in w_a), w_r)
-    w = tuple(jnp.concatenate([cl, ca[ZWIN:]], axis=0)
+    sel_r = ed.lookup_windows(tab_r, sc_nibbles(z16)[:ZWIN])
+    sel_a = ed.lookup_windows(tab_a, sc_nibbles(t))     # (L, 64, N)
+    w_r = ed.pt_tree_sum(sel_r)                         # (L, ZWIN)
+    w_a = ed.pt_tree_sum(sel_a)                         # (L, 64)
+    lo = ed.pt_add(tuple(c[:, :ZWIN] for c in w_a), w_r)
+    w = tuple(jnp.concatenate([cl, ca[:, ZWIN:]], axis=1)
               for cl, ca in zip(lo, w_a))
 
     # fold [S]B into the same windows via the shared base table

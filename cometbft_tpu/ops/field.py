@@ -128,28 +128,42 @@ def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
 
 def spread_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """(la, ...) x (lb, ...) limbs -> (la+lb, ...) un-carried accumulation,
-    each output limb < (la+lb)*2^16 (int32-safe for la+lb <= 34).
+    each output limb < 2*la*2^16 (int32-safe for la+lb <= 34).
 
-    Unrolled schoolbook: exact uint32 row products split into lo/hi
-    16-bit halves, accumulated into output rows — one fuseable
-    elementwise chain, no matmul, full lane occupancy. Shared by field
-    (16x16) and scalar-mod-L (Barrett widths) muls — keep the exactness
-    bounds in this one place."""
+    Tensorized schoolbook: ONE exact uint32 outer-product multiply
+    (la, lb, ...), split into lo/hi 16-bit halves, then each row i is
+    statically shifted to its output offset (i for lo, i+1 for hi) and
+    summed — polynomial multiplication as pad-shift-add. Emits ~70 HLO
+    ops instead of an O(la*lb) unrolled chain: trace/compile size is what
+    killed the first formulation (every downstream kernel — straus loop,
+    MSM tree — inlines hundreds of these). Work is identical; everything
+    stays elementwise on the VPU with the batch axis in the lanes.
+    Shared by field (16x16) and scalar-mod-L (Barrett widths) muls — keep
+    the exactness bounds in this one place."""
     la, lb = a.shape[0], b.shape[0]
     assert la + lb <= 34
-    au = a.astype(jnp.uint32)
-    bu = b.astype(jnp.uint32)
-    zero = jnp.zeros(jnp.broadcast_shapes(a.shape[1:], b.shape[1:]),
-                     dtype=jnp.int32)
-    acc = [zero] * (la + lb)
+    batch = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    # numpy-style trailing alignment of the batch dims, limb axis pinned
+    pad = lambda x, n: x.reshape(n, *([1] * (len(batch) - (x.ndim - 1))),
+                                 *x.shape[1:])
+    au = jnp.broadcast_to(pad(a, la), (la, *batch)).astype(jnp.uint32)
+    bu = jnp.broadcast_to(pad(b, lb), (lb, *batch)).astype(jnp.uint32)
+    p = au[:, None] * bu[None]                      # (la, lb, ...) exact
+    lo = (p & MASK).astype(jnp.int32)
+    hi = (p >> LIMB_BITS).astype(jnp.int32)
+
+    width = la + lb
+    def shifted(row: jnp.ndarray, off: int) -> jnp.ndarray:
+        zl = jnp.zeros((off, *batch), dtype=jnp.int32)
+        zr = jnp.zeros((width - off - lb, *batch), dtype=jnp.int32)
+        return jnp.concatenate([zl, row, zr], axis=0)
+
+    acc = shifted(lo[0], 0)
     for i in range(la):
-        p = au[i][None] * bu                       # (lb, ...)
-        lo = (p & MASK).astype(jnp.int32)
-        hi = (p >> LIMB_BITS).astype(jnp.int32)
-        for j in range(lb):
-            acc[i + j] = acc[i + j] + lo[j]
-            acc[i + j + 1] = acc[i + j + 1] + hi[j]
-    return jnp.stack(acc)
+        if i:
+            acc = acc + shifted(lo[i], i)
+        acc = acc + shifted(hi[i], i + 1)
+    return acc
 
 
 def _fold_mod_p(acc: jnp.ndarray) -> jnp.ndarray:

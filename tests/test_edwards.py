@@ -36,19 +36,20 @@ def rand_points(n):
 
 
 def to_limbs(pts):
-    """oracle points -> batched Point of (n, 16) limb arrays."""
+    """oracle points -> batched Point of (16, n) limb arrays (limb axis
+    leading, batch trailing)."""
     arrs = [[], [], [], []]
     for p in pts:
         for i, c in enumerate(p):
             arrs[i].append(limbs_from_int(c % ref.P))
-    return tuple(jnp.asarray(np.stack(a)) for a in arrs)
+    return tuple(jnp.asarray(np.stack(a, axis=-1)) for a in arrs)
 
 
 def assert_pt_eq(jp, oracle_pts):
     x, y, z, t = [np.asarray(c) for c in jp]
     for i, op in enumerate(oracle_pts):
-        got = (int_from_limbs(x[i]), int_from_limbs(y[i]),
-               int_from_limbs(z[i]), int_from_limbs(t[i]))
+        got = (int_from_limbs(x[:, i]), int_from_limbs(y[:, i]),
+               int_from_limbs(z[:, i]), int_from_limbs(t[:, i]))
         assert ref.pt_eq(got, op), f"point {i} mismatch"
         # extended-coordinate invariant T = XY/Z
         gx, gy, gz, gt = [v % ref.P for v in got]
@@ -74,7 +75,7 @@ def test_add_identity_and_inverse():
 def test_decompress_roundtrip():
     ps = rand_points(8)
     enc = np.stack([np.frombuffer(ref.pt_compress(p), dtype=np.uint8)
-                    for p in ps])
+                    for p in ps], axis=-1)        # byte axis leading (32, 8)
     pt, ok = j_decompress(jnp.asarray(enc))
     assert bool(jnp.all(ok))
     assert_pt_eq(pt, ps)
@@ -95,7 +96,7 @@ def test_decompress_invalid_and_zip215():
     assert ref.pt_decompress((3).to_bytes(32, "little")) is not None
     noncanon = (ref.P + 3).to_bytes(32, "little")
     enc = np.stack([np.frombuffer(b, dtype=np.uint8)
-                    for b in (bad, noncanon)])
+                    for b in (bad, noncanon)], axis=-1)
     _, ok = j_decompress(jnp.asarray(enc), zip215=True)
     assert list(np.asarray(ok)) == [False, True]
     _, ok = j_decompress(jnp.asarray(enc), zip215=False)
@@ -106,7 +107,8 @@ def test_window_table_and_scalar_mul():
     ps = rand_points(3)
     jp = to_limbs(ps)
     ks = [rand_scalar() for _ in range(3)]
-    klimbs = jnp.asarray(np.stack([limbs_from_int(k)[:16] for k in ks]))
+    klimbs = jnp.asarray(np.stack([limbs_from_int(k)[:16] for k in ks],
+                                  axis=-1))
     got = j_scalar_mul(klimbs, jp)
     assert_pt_eq(got, [ref.pt_mul(k, p) for k, p in zip(ks, ps)])
 
@@ -116,8 +118,8 @@ def test_straus_double_mul():
     jp = to_limbs(ps)
     ss = [rand_scalar() for _ in range(4)]
     ks = [rand_scalar() for _ in range(4)]
-    sl = jnp.asarray(np.stack([limbs_from_int(s)[:16] for s in ss]))
-    kl = jnp.asarray(np.stack([limbs_from_int(k)[:16] for k in ks]))
+    sl = jnp.asarray(np.stack([limbs_from_int(s)[:16] for s in ss], axis=-1))
+    kl = jnp.asarray(np.stack([limbs_from_int(k)[:16] for k in ks], axis=-1))
     tab = j_window_table(jp)
     got = j_straus(sl, kl, tab)
     want = [ref.pt_add(ref.pt_mul(s, ref.BASE), ref.pt_mul(k, p))

@@ -19,11 +19,13 @@ def rand_int():
 
 
 def to_limbs_batch(xs):
-    return jnp.asarray(np.stack([fe.limbs_from_int(x) for x in xs]))
+    # limb axis LEADING: (16, B)
+    return jnp.asarray(np.stack([fe.limbs_from_int(x) for x in xs], axis=-1))
 
 
 def from_limbs_batch(arr):
-    return [fe.int_from_limbs(np.asarray(arr)[i]) for i in range(arr.shape[0])]
+    a = np.asarray(arr)
+    return [fe.int_from_limbs(a[:, i]) for i in range(a.shape[1])]
 
 
 def test_roundtrip():
@@ -56,13 +58,13 @@ def test_add_sub_mul():
         assert mul[i] % P == (a_int[i] * b_int[i]) % P
         assert sq[i] % P == (a_int[i] * a_int[i]) % P
 
-    # mixed-shape broadcast: (16,) constant against (B,16) batch, both orders
+    # mixed-shape broadcast: (16,) constant against (16,B) batch, both orders
     c3 = fe.fe_const(3)
     m1 = np.asarray(jax.jit(fe.fe_mul)(a, c3))
     m2 = np.asarray(jax.jit(fe.fe_mul)(c3, a))
     assert np.array_equal(m1, m2)
     for i in range(n):
-        assert fe.int_from_limbs(m1[i]) % P == (3 * a_int[i]) % P
+        assert fe.int_from_limbs(m1[:, i]) % P == (3 * a_int[i]) % P
 
 
 def test_limbs_strictly_16bit():
@@ -72,7 +74,7 @@ def test_limbs_strictly_16bit():
     out = np.asarray(jax.jit(fe.fe_carry)(a))
     assert out.max() < 2**16
     for i, x in enumerate(xs):
-        assert fe.int_from_limbs(out[i]) % P == x % P
+        assert fe.int_from_limbs(out[:, i]) % P == x % P
 
 
 def test_canonical_eq():
@@ -113,7 +115,7 @@ def test_parity_bytes():
     xs = [rand_int() for _ in range(8)]
     a = to_limbs_batch(xs)
     par = np.asarray(jax.jit(fe.fe_parity)(a))
-    byts = np.asarray(jax.jit(fe.fe_to_bytes_limbs)(a))
+    byts = np.asarray(jax.jit(fe.fe_to_bytes_limbs)(a))  # (32, B)
     for i, x in enumerate(xs):
         assert par[i] == (x % P) & 1
-        assert bytes(byts[i]) == (x % P).to_bytes(32, "little")
+        assert bytes(byts[:, i]) == (x % P).to_bytes(32, "little")

@@ -15,17 +15,19 @@ rng = random.Random(99)
 
 
 def wide_limbs(xs):
+    # limb axis LEADING: (32, B)
     return jnp.asarray(np.stack([
         np.array([(x >> (16 * i)) & 0xFFFF for i in range(32)], dtype=np.int32)
-        for x in xs]))
+        for x in xs], axis=-1))
 
 
 def narrow_limbs(xs):
-    return jnp.asarray(np.stack([fe.limbs_from_int(x) for x in xs]))
+    return jnp.asarray(np.stack([fe.limbs_from_int(x) for x in xs], axis=-1))
 
 
 def from_limbs(arr):
-    return [fe.int_from_limbs(np.asarray(arr)[i]) for i in range(arr.shape[0])]
+    a = np.asarray(arr)
+    return [fe.int_from_limbs(a[:, i]) for i in range(a.shape[1])]
 
 
 def test_reduce_wide():
@@ -65,20 +67,20 @@ def test_lt_l():
 def test_nibbles_bits():
     xs = [rng.getrandbits(256) for _ in range(4)]
     a = narrow_limbs(xs)
-    nibs = np.asarray(jax.jit(sc.sc_nibbles)(a))
-    bits = np.asarray(jax.jit(sc.sc_bits)(a))
+    nibs = np.asarray(jax.jit(sc.sc_nibbles)(a))  # (64, B)
+    bits = np.asarray(jax.jit(sc.sc_bits)(a))     # (256, B)
     for i, x in enumerate(xs):
-        assert sum(int(nibs[i][j]) << (4 * j) for j in range(64)) == x
-        assert sum(int(bits[i][j]) << j for j in range(256)) == x
+        assert sum(int(nibs[j, i]) << (4 * j) for j in range(64)) == x
+        assert sum(int(bits[j, i]) << j for j in range(256)) == x
 
 
 def test_bytes_roundtrip():
     xs = [rng.getrandbits(256) for _ in range(4)]
     raw = jnp.asarray(np.stack([
         np.frombuffer(x.to_bytes(32, "little"), dtype=np.uint8)
-        for x in xs]))
+        for x in xs], axis=-1))                   # byte axis leading (32, B)
     limbs = jax.jit(sc.bytes_to_limbs)(raw)
     assert from_limbs(limbs) == xs
     back = np.asarray(jax.jit(sc.limbs_to_bytes)(limbs))
     for i, x in enumerate(xs):
-        assert bytes(back[i]) == x.to_bytes(32, "little")
+        assert bytes(back[:, i]) == x.to_bytes(32, "little")

@@ -10,13 +10,13 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache"))
 
 import numpy as np
 import jax
+
+from cometbft_tpu.libs.jax_cache import enable_compile_cache
+
+enable_compile_cache()
 import jax.numpy as jnp
 from jax import lax
 
@@ -55,8 +55,9 @@ def chain(opfn):
 
 def main():
     rng = np.random.default_rng(0)
+    # limb axis LEADING (16, *batch) — the field.py layout
     limbs = lambda *s: jnp.asarray(
-        rng.integers(0, 1 << 16, size=(*s, 16), dtype=np.int32))
+        rng.integers(0, 1 << 16, size=(16, *s), dtype=np.int32))
     print(f"device={jax.devices()[0].platform} N={N} K={K}")
 
     pt = (limbs(N), limbs(N), limbs(N), limbs(N))
@@ -83,12 +84,12 @@ def main():
     timeit("pt_double (N)", chain(ed.pt_double), pt)
 
     # decompress x10
-    enc = jnp.asarray(rng.integers(0, 256, size=(N, 32), dtype=np.uint8))
+    enc = jnp.asarray(rng.integers(0, 256, size=(32, N), dtype=np.uint8))
     @jax.jit
     def dec(e):
         def step(c, _):
             p, ok = ed.pt_decompress(e)
-            return c + p[0][..., 0] * ok, None
+            return c + p[0][0] * ok, None
         c, _ = lax.scan(step, jnp.zeros((N,), jnp.int32), None, length=4)
         return c
     K_save = K
