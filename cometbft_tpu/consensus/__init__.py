@@ -1,0 +1,7 @@
+from .wal import WAL, NilWAL, EndHeightMessage, WALMessage
+from .ticker import TimeoutTicker, TimeoutInfo
+from .state import ConsensusState, ConsensusConfig
+
+__all__ = ["WAL", "NilWAL", "EndHeightMessage", "WALMessage",
+           "TimeoutTicker", "TimeoutInfo", "ConsensusState",
+           "ConsensusConfig"]

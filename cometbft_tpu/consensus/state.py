@@ -1,0 +1,773 @@
+"""The Tendermint consensus state machine — single-writer event loop
+(reference internal/consensus/state.go: receiveRoutine :778, round steps
+:1046-1914, vote accretion :2205-2470, own-vote signing :2471-2549).
+
+Architecture: all mutations flow through `handle_msg`, called either from
+the owning thread's `receive_routine` (live mode) or directly by a test
+scheduler — the actor model the reference enforces with its
+receiveRoutine goroutine (SURVEY §2.3). The TPU data plane is downstream:
+votes verify through the crypto seam (crypto/batch + ops/ed25519), and
+commits created here are what blocksync's tiled verifier checks in bulk.
+
+WAL discipline (reference state.go:825,833,1890): every message is
+WAL-logged BEFORE processing; own votes/proposals and #ENDHEIGHT markers
+are fsynced. Crash replay re-feeds messages after the last #ENDHEIGHT
+through the same handlers with side effects (broadcast, WAL append)
+suppressed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, List, Optional, Union
+
+from ..privval.file import DoubleSignError, PrivValidator
+from ..state.execution import BlockExecutor, BlockValidationError
+from ..state.state import State
+from ..types.block import Block, BlockID, Commit, Part, PartSet
+from ..types.proto import Timestamp
+from ..types.vote import (Proposal, Vote, PREVOTE_TYPE, PRECOMMIT_TYPE)
+from ..types.vote_set import ErrVoteConflictingVotes, VoteError, VoteSet
+from .height_vote_set import HeightVoteSet
+from .ticker import TimeoutInfo, TimeoutTicker
+from .wal import (EndHeightMessage, NilWAL, WALBlockPart, WALProposal,
+                  WALTimeout, WALVote)
+
+# RoundStepType (reference internal/consensus/types/round_state.go:14-25)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+
+@dataclass
+class ConsensusConfig:
+    """Timeouts in ms (reference config/config.go consensus section).
+    Defaults scaled down from the reference's 3000/1000/1000/1000 — tests
+    override smaller still."""
+    timeout_propose: int = 3000
+    timeout_propose_delta: int = 500
+    timeout_prevote: int = 1000
+    timeout_prevote_delta: int = 500
+    timeout_precommit: int = 1000
+    timeout_precommit_delta: int = 500
+    timeout_commit: int = 1000
+    create_empty_blocks: bool = True
+
+    def propose(self, round_: int) -> int:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote(self, round_: int) -> int:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit(self, round_: int) -> int:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+
+@dataclass(frozen=True)
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass(frozen=True)
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+
+@dataclass(frozen=True)
+class VoteMessage:
+    vote: Vote
+
+
+Message = Union[ProposalMessage, BlockPartMessage, VoteMessage, TimeoutInfo]
+
+
+@dataclass
+class RoundState:
+    """reference internal/consensus/types/round_state.go:65-100."""
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NEW_HEIGHT
+    proposal: Optional[Proposal] = None
+    proposal_block: Optional[Block] = None
+    proposal_block_parts: Optional[PartSet] = None
+    locked_round: int = -1
+    locked_block: Optional[Block] = None
+    locked_block_parts: Optional[PartSet] = None
+    valid_round: int = -1
+    valid_block: Optional[Block] = None
+    valid_block_parts: Optional[PartSet] = None
+    votes: Optional[HeightVoteSet] = None
+    commit_round: int = -1
+    last_commit: Optional[VoteSet] = None
+    triggered_timeout_precommit: bool = False
+
+
+class ConsensusState:
+    """reference internal/consensus/state.go State."""
+
+    def __init__(self, config: ConsensusConfig, state: State,
+                 executor: BlockExecutor, block_store,
+                 priv_validator: Optional[PrivValidator] = None,
+                 wal=None, ticker_cls=TimeoutTicker,
+                 name: str = ""):
+        self.config = config
+        self.executor = executor
+        self.block_store = block_store
+        self.priv_validator = priv_validator
+        self.wal = wal if wal is not None else NilWAL()
+        self.name = name
+        self.chain_id = state.chain_id
+
+        self.rs = RoundState()
+        self.state = state  # committed state (height = last applied)
+
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.ticker = ticker_cls(self._deliver_timeout)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._replaying = False
+
+        # harness/reactor hooks
+        self.broadcast: Callable[[Message], None] = lambda msg: None
+        self.on_commit: Callable[[Block, Commit], None] = lambda b, c: None
+        # double-sign material for the evidence pool (reference
+        # state.go:2256 → evpool.AddEvidence)
+        self.conflicting_votes: List[ErrVoteConflictingVotes] = []
+        self.evidence_pool = None
+
+        # future-(height,round) messages parked until we get there: the
+        # reference relies on per-peer gossip routines retransmitting
+        # (consensus/reactor.go:570,625); with queue-delivery transports
+        # the state machine re-injects instead. Bounded to keep a flooding
+        # peer from ballooning memory.
+        self._pending: List[tuple] = []
+        self._pending_cap = 10000
+
+        self._priv_pubkey = (priv_validator.get_pub_key()
+                             if priv_validator else None)
+        self._update_to_state(state)
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Replay the WAL, then run the receive loop in a thread
+        (reference state.go OnStart: catchup replay then receiveRoutine)."""
+        self.catchup_replay()
+        self._thread = threading.Thread(
+            target=self.receive_routine,
+            name=f"consensus-{self.name}", daemon=True)
+        self._thread.start()
+        # kick off the first height (reference scheduleRound0)
+        self.ticker.schedule(TimeoutInfo(
+            0, self.rs.height, 0, STEP_NEW_HEIGHT))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.ticker.stop()
+        self.inbox.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def receive_routine(self) -> None:
+        """Single writer (reference state.go:778-866)."""
+        while not self._stop.is_set():
+            msg = self.inbox.get()
+            if msg is None:
+                break
+            try:
+                self.handle_msg(msg)
+            except DoubleSignError:
+                raise  # never continue past a refused signature
+            except Exception:  # noqa: BLE001 — a bad peer msg must not
+                # kill the loop (reference recovers/logs, state.go:784-800)
+                import traceback
+                traceback.print_exc()
+
+    def send(self, msg: Message, peer_id: str = "") -> None:
+        """Enqueue a message from a peer or self (thread-safe)."""
+        self.inbox.put((msg, peer_id) if peer_id else msg)
+
+    def _deliver_timeout(self, ti: TimeoutInfo) -> None:
+        self.inbox.put(ti)
+
+    # --- message dispatch ----------------------------------------------------
+
+    def handle_msg(self, msg, peer_id: str = "") -> None:
+        """reference state.go:869-926 handleMsg + :988 handleTimeout."""
+        if isinstance(msg, tuple):
+            msg, peer_id = msg
+        if isinstance(msg, TimeoutInfo):
+            self._handle_timeout(msg)
+            return
+        if isinstance(msg, ProposalMessage):
+            if not self._replaying:
+                self.wal.write(WALProposal(msg.proposal, peer_id))
+        elif isinstance(msg, BlockPartMessage):
+            if not self._replaying:
+                self.wal.write(WALBlockPart(
+                    msg.height, msg.round, msg.part.index,
+                    msg.part.encode(), peer_id))
+        elif isinstance(msg, VoteMessage):
+            if not self._replaying:
+                if peer_id == "":  # own vote: fsync (state.go:825)
+                    self.wal.write_sync(WALVote(msg.vote))
+                else:
+                    self.wal.write(WALVote(msg.vote, peer_id))
+        else:
+            raise TypeError(f"unknown consensus message {type(msg)}")
+        self._dispatch(msg, peer_id)
+
+    def _dispatch(self, msg, peer_id: str) -> None:
+        """Route to a handler, parking future-(height,round) messages
+        (WAL-logged already — re-injection skips the log)."""
+        if self._park_if_future(msg, peer_id):
+            return
+        if isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            self._add_proposal_block_part(msg)
+        elif isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, peer_id)
+
+    def _park_if_future(self, msg, peer_id: str) -> bool:
+        rs = self.rs
+        if isinstance(msg, VoteMessage):
+            future = msg.vote.height > rs.height
+        elif isinstance(msg, ProposalMessage):
+            future = (msg.proposal.height, msg.proposal.round) > \
+                (rs.height, rs.round)
+        elif isinstance(msg, BlockPartMessage):
+            future = (msg.height, msg.round) > (rs.height, rs.round)
+        else:
+            return False
+        if future and len(self._pending) < self._pending_cap:
+            self._pending.append((msg, peer_id))
+            return True
+        return future
+
+    def _replay_pending(self) -> None:
+        """Re-inject parked messages now deliverable (called on every
+        height/round entry; runs on the single-writer thread)."""
+        if not self._pending:
+            return
+        parked, self._pending = self._pending, []
+        for msg, peer_id in parked:
+            self._dispatch(msg, peer_id)
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """reference state.go:988-1040."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or \
+                (ti.round == rs.round and ti.step < rs.step):
+            return  # stale
+        if not self._replaying:
+            self.wal.write(WALTimeout(ti.height, ti.round, ti.step,
+                                      ti.duration_ms))
+        if ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+
+    # --- height/round transitions -------------------------------------------
+
+    def _update_to_state(self, state: State) -> None:
+        """Start a new height (reference state.go updateToState
+        :1046-1135 analog)."""
+        last_precommits = None
+        if self.rs.commit_round > -1 and self.rs.votes is not None:
+            vs = self.rs.votes.precommits(self.rs.commit_round)
+            if vs.has_two_thirds_majority():
+                last_precommits = vs
+        # reference state.go updateToState: height 0 means pre-genesis
+        height = (state.initial_height if state.last_block_height == 0
+                  else state.last_block_height + 1)
+        self.state = state
+        self.rs = RoundState(
+            height=height,
+            round=0,
+            step=STEP_NEW_HEIGHT,
+            votes=HeightVoteSet(self.chain_id, height, state.validators),
+            last_commit=last_precommits,
+        )
+
+    def _proposer_for(self, round_: int):
+        vals = self.state.validators
+        if round_ == 0:
+            return vals.get_proposer()
+        return vals.copy_increment_proposer_priority(round_).get_proposer()
+
+    def _is_proposer(self, round_: int) -> bool:
+        if self._priv_pubkey is None:
+            return False
+        prop = self._proposer_for(round_)
+        return prop is not None and \
+            prop.address == self._priv_pubkey.address()
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """reference state.go:1046-1133."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step != STEP_NEW_HEIGHT):
+            return
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        if round_ != 0:
+            # a new round invalidates the old proposal (reference keeps
+            # valid_block for re-proposal)
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.triggered_timeout_precommit = False
+        rs.votes.set_round(round_ + 1)
+        self._enter_propose(height, round_)
+        self._replay_pending()
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """reference state.go:1135-1207."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PROPOSE):
+            return
+        rs.step = STEP_PROPOSE
+        self.ticker.schedule(TimeoutInfo(
+            self.config.propose(round_), height, round_, STEP_PROPOSE))
+        if self._is_proposer(round_):
+            self._decide_proposal(height, round_)
+        if self._is_proposal_complete():
+            self._enter_prevote(height, round_)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """reference state.go:1209-1264 defaultDecideProposal."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            last_commit = self._last_commit_for_proposal(height)
+            if last_commit is None:
+                return
+            block = self.executor.create_proposal_block(
+                height, self.state, last_commit,
+                self._priv_pubkey.address())
+            parts = block.make_part_set()
+        block_id = BlockID(block.hash(), parts.header)
+        proposal = Proposal(height=height, round=round_,
+                            pol_round=rs.valid_round, block_id=block_id,
+                            timestamp=Timestamp.now())
+        try:
+            self.priv_validator.sign_proposal(self.chain_id, proposal)
+        except DoubleSignError:
+            return
+        # deliver to self through the internal queue path, then gossip
+        self.handle_msg(ProposalMessage(proposal))
+        for part in parts.parts:
+            self.handle_msg(BlockPartMessage(height, round_, part))
+        if not self._replaying:
+            self.broadcast(ProposalMessage(proposal))
+            for part in parts.parts:
+                self.broadcast(BlockPartMessage(height, round_, part))
+
+    def _last_commit_for_proposal(self, height: int) -> Optional[Commit]:
+        if height == self.state.initial_height:
+            return Commit(height=0, round=0)
+        if self.rs.last_commit is not None and \
+                self.rs.last_commit.has_two_thirds_majority():
+            return self.rs.last_commit.make_commit()
+        return None
+
+    def _is_proposal_complete(self) -> bool:
+        """reference state.go:1266-1283."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        return rs.votes.prevotes(
+            rs.proposal.pol_round).has_two_thirds_any()
+
+    # --- proposal intake -----------------------------------------------------
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """reference state.go:2084-2124 defaultSetProposal."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        try:
+            proposal.validate_basic()
+        except ValueError:
+            return
+        proposer = self._proposer_for(rs.round)
+        if proposer is None:
+            return
+        sb = proposal.sign_bytes(self.chain_id)
+        if not proposer.pub_key.verify_signature(sb, proposal.signature):
+            return  # ErrInvalidProposalSignature
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.new_from_header(
+                proposal.block_id.parts)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage) -> None:
+        """reference state.go:2126-2203."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return
+        if rs.proposal_block_parts is None:
+            return  # no proposal yet; the reference buffers, we drop
+        if not rs.proposal_block_parts.add_part(msg.part):
+            return
+        if not rs.proposal_block_parts.is_complete():
+            return
+        try:
+            block = Block.decode(rs.proposal_block_parts.reassemble())
+        except (ValueError, IndexError):
+            return
+        if rs.proposal is not None and \
+                block.hash() != rs.proposal.block_id.hash:
+            return  # parts complete but wrong block: proposer lied
+        rs.proposal_block = block
+
+        prevotes = rs.votes.prevotes(rs.round)
+        bid = prevotes.two_thirds_majority()
+        if bid is not None and not bid.is_nil() and rs.valid_round < rs.round:
+            if block.hash() == bid.hash:
+                rs.valid_round = rs.round
+                rs.valid_block = block
+                rs.valid_block_parts = rs.proposal_block_parts
+
+        if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(rs.height, rs.round)
+        elif rs.step == STEP_COMMIT:
+            self._try_finalize_commit(rs.height)
+
+    # --- prevote -------------------------------------------------------------
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """reference state.go:1328-1352."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PREVOTE):
+            return
+        rs.step = STEP_PREVOTE
+        self._do_prevote(height, round_)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """reference state.go:1354-1422 defaultDoPrevote."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(),
+                                rs.locked_block_parts.header)
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
+        try:
+            self.executor.validate_block(self.state, rs.proposal_block)
+            app_ok = self.executor.process_proposal(
+                rs.proposal_block, self.state)
+        except (BlockValidationError, Exception):
+            app_ok = False
+        if app_ok:
+            self._sign_add_vote(PREVOTE_TYPE, rs.proposal_block.hash(),
+                                rs.proposal_block_parts.header)
+        else:
+            self._sign_add_vote(PREVOTE_TYPE, b"", None)
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """reference state.go:1424-1448."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PREVOTE_WAIT):
+            return
+        rs.step = STEP_PREVOTE_WAIT
+        self.ticker.schedule(TimeoutInfo(
+            self.config.prevote(round_), height, round_, STEP_PREVOTE_WAIT))
+
+    # --- precommit -----------------------------------------------------------
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """reference state.go:1450-1552."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PRECOMMIT):
+            return
+        rs.step = STEP_PRECOMMIT
+        bid = rs.votes.prevotes(round_).two_thirds_majority()
+        if bid is None:
+            # no POL for this round: precommit nil
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+        if bid.is_nil():
+            # +2/3 prevoted nil: unlock and precommit nil
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+        if rs.locked_block is not None and \
+                rs.locked_block.hash() == bid.hash:
+            rs.locked_round = round_
+            self._sign_add_vote(PRECOMMIT_TYPE, bid.hash, bid.parts)
+            return
+        if rs.proposal_block is not None and \
+                rs.proposal_block.hash() == bid.hash:
+            try:
+                self.executor.validate_block(self.state, rs.proposal_block)
+            except BlockValidationError:
+                # +2/3 prevoted an invalid block — cannot happen with <1/3
+                # byzantine; do not lock, precommit nil
+                self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+                return
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self._sign_add_vote(PRECOMMIT_TYPE, bid.hash, bid.parts)
+            return
+        # +2/3 prevotes for a block we don't have: unlock, fetch it
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or \
+                rs.proposal_block_parts.header != bid.parts:
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.new_from_header(bid.parts)
+        self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """reference state.go:1554-1580."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.triggered_timeout_precommit):
+            return
+        rs.triggered_timeout_precommit = True
+        self.ticker.schedule(TimeoutInfo(
+            self.config.precommit(round_), height, round_,
+            STEP_PRECOMMIT_WAIT))
+
+    # --- commit --------------------------------------------------------------
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """reference state.go:1582-1643."""
+        rs = self.rs
+        if rs.height != height or rs.step >= STEP_COMMIT:
+            return
+        rs.step = STEP_COMMIT
+        rs.commit_round = commit_round
+        bid = rs.votes.precommits(commit_round).two_thirds_majority()
+        if bid is None or bid.is_nil():
+            raise AssertionError("enterCommit without +2/3 precommits")
+        if rs.locked_block is not None and \
+                rs.locked_block.hash() == bid.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or \
+                rs.proposal_block.hash() != bid.hash:
+            if rs.proposal_block_parts is None or \
+                    rs.proposal_block_parts.header != bid.parts:
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet.new_from_header(bid.parts)
+            return  # wait for parts
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """reference state.go:1645-1671."""
+        rs = self.rs
+        if rs.height != height or rs.step != STEP_COMMIT:
+            return
+        bid = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if bid is None or bid.is_nil():
+            return
+        if rs.proposal_block is None or \
+                rs.proposal_block.hash() != bid.hash:
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """reference state.go:1673-1770 finalizeCommit."""
+        rs = self.rs
+        block = rs.proposal_block
+        parts = rs.proposal_block_parts
+        bid = BlockID(block.hash(), parts.header)
+        seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+
+        if self.block_store is not None and \
+                self.block_store.height() < height:
+            self.block_store.save_block(block, parts, seen_commit)
+
+        # the WAL must know the height is decided before the app mutates
+        # (reference state.go:1890 WriteSync EndHeightMessage)
+        if not self._replaying:
+            self.wal.write_sync(EndHeightMessage(height))
+
+        new_state, _resp = self.executor.apply_block(
+            self.state, bid, block, verified=True)
+        self.on_commit(block, seen_commit)
+        self._update_to_state(new_state)
+        # schedule the NewHeight timeout: gather more precommits before
+        # starting the next round (reference timeout_commit)
+        self.ticker.schedule(TimeoutInfo(
+            self.config.timeout_commit, self.rs.height, 0,
+            STEP_NEW_HEIGHT))
+
+    # --- votes ---------------------------------------------------------------
+
+    def _sign_add_vote(self, type_: int, hash_: bytes, psh) -> None:
+        """reference state.go:2471-2549 signAddVote."""
+        if self.priv_validator is None:
+            return
+        addr = self._priv_pubkey.address()
+        idx, _val = self.state.validators.get_by_address(addr)
+        if idx is None or idx < 0:
+            return  # not a validator this height
+        rs = self.rs
+        bid = BlockID(hash_, psh) if hash_ else BlockID()
+        vote = Vote(type_=type_, height=rs.height, round=rs.round,
+                    block_id=bid, timestamp=Timestamp.now(),
+                    validator_address=addr, validator_index=idx)
+        try:
+            self.priv_validator.sign_vote(self.chain_id, vote)
+        except DoubleSignError:
+            return  # never sign conflicting votes; stay silent
+        self.handle_msg(VoteMessage(vote))
+        if not self._replaying:
+            self.broadcast(VoteMessage(vote))
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> None:
+        """reference state.go:2256-2339 tryAddVote: conflicting votes
+        become evidence instead of crashing the loop."""
+        try:
+            self._add_vote(vote, peer_id)
+        except ErrVoteConflictingVotes as err:
+            self.conflicting_votes.append(err)
+            if self.evidence_pool is not None:
+                self.evidence_pool.add_duplicate_vote(
+                    err.vote_a, err.vote_b, self.state)
+        except VoteError:
+            pass  # bad vote from a peer: drop (the reactor would punish)
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> None:
+        """reference state.go:2341-2469 addVote."""
+        rs = self.rs
+        # precommit for the previous height (late catch-up votes)
+        if vote.height + 1 == rs.height and \
+                vote.type_ == PRECOMMIT_TYPE:
+            if rs.step != STEP_NEW_HEIGHT or rs.last_commit is None:
+                return
+            rs.last_commit.add_vote(vote)
+            return
+        if vote.height != rs.height:
+            return
+
+        rs.votes.add_vote(vote, peer_id)
+        if vote.type_ == PREVOTE_TYPE:
+            self._on_prevote_added(vote)
+        else:
+            self._on_precommit_added(vote)
+
+    def _on_prevote_added(self, vote: Vote) -> None:
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        bid = prevotes.two_thirds_majority()
+        if bid is not None:
+            # unlock if a newer POL exists for a different block
+            # (reference state.go:2392-2403)
+            if rs.locked_block is not None and \
+                    rs.locked_round < vote.round <= rs.round and \
+                    rs.locked_block.hash() != bid.hash:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            # update valid block (reference state.go:2405-2425)
+            if not bid.is_nil() and rs.valid_round < vote.round and \
+                    vote.round == rs.round:
+                if rs.proposal_block is not None and \
+                        rs.proposal_block.hash() == bid.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    rs.proposal_block = None
+                    if rs.proposal_block_parts is None or \
+                            rs.proposal_block_parts.header != bid.parts:
+                        rs.proposal_block_parts = \
+                            PartSet.new_from_header(bid.parts)
+
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and rs.step >= STEP_PREVOTE:
+            if bid is not None and \
+                    (self._is_proposal_complete() or bid.is_nil()):
+                self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any() and \
+                    rs.step == STEP_PREVOTE:
+                self._enter_prevote_wait(rs.height, vote.round)
+        elif rs.proposal is not None and \
+                0 <= rs.proposal.pol_round == vote.round:
+            if self._is_proposal_complete():
+                self._enter_prevote(rs.height, rs.round)
+
+    def _on_precommit_added(self, vote: Vote) -> None:
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        bid = precommits.two_thirds_majority()
+        if bid is not None:
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit(rs.height, vote.round)
+            if not bid.is_nil():
+                self._enter_commit(rs.height, vote.round)
+                if precommits.has_all():
+                    # everyone signed: no need to wait (reference
+                    # skipTimeoutCommit)
+                    pass
+            else:
+                self._enter_precommit_wait(rs.height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit_wait(rs.height, vote.round)
+
+    # --- WAL replay ----------------------------------------------------------
+
+    def catchup_replay(self) -> None:
+        """Re-feed WAL messages recorded after the last #ENDHEIGHT
+        (reference replay.go:95 catchupReplay). Handlers run with
+        broadcast and WAL writes suppressed; the privval double-sign
+        guard idempotently re-releases identical signatures."""
+        msgs = self.wal.replay_messages(self.state.last_block_height)
+        if not msgs:
+            return
+        self._replaying = True
+        try:
+            # the height must be entered before messages land
+            self._enter_new_round(self.rs.height, 0)
+            for m in msgs:
+                if isinstance(m, EndHeightMessage):
+                    continue
+                if isinstance(m, WALVote):
+                    self._try_add_vote(m.vote, m.peer_id)
+                elif isinstance(m, WALProposal):
+                    self._set_proposal(m.proposal)
+                elif isinstance(m, WALBlockPart):
+                    self._add_proposal_block_part(BlockPartMessage(
+                        m.height, m.round, Part.decode(m.part)))
+                elif isinstance(m, WALTimeout):
+                    self._handle_timeout(TimeoutInfo(
+                        m.duration_ms, m.height, m.round, m.step))
+        finally:
+            self._replaying = False

@@ -1,0 +1,105 @@
+"""Timeout scheduling for the consensus state machine
+(reference internal/consensus/ticker.go:29-91).
+
+One pending timeout at a time: scheduling a newer (height, round, step)
+replaces any older pending one (the reference drains and stops the timer,
+ticker.go:105-126). Fired timeouts are delivered into the state machine's
+inbox like any other message — the single-writer loop stays the only
+mutator.
+
+`ManualTicker` gives tests a virtual clock: `fire_pending()` pops the
+pending timeout synchronously, so round progression is deterministic and
+instant (the reference's tests swap the ticker the same way,
+common_test.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass(frozen=True, order=True)
+class TimeoutInfo:
+    """reference ticker.go timeoutInfo (duration first so ordering is by
+    deadline-irrelevant fields only via explicit compare below)."""
+    duration_ms: int
+    height: int
+    round: int
+    step: int
+
+    def newer_than(self, other: "TimeoutInfo") -> bool:
+        return ((self.height, self.round, self.step)
+                > (other.height, other.round, other.step))
+
+
+class TimeoutTicker:
+    """Real-time ticker backed by threading.Timer."""
+
+    def __init__(self, deliver: Callable[[TimeoutInfo], None]):
+        self._deliver = deliver
+        self._timer: Optional[threading.Timer] = None
+        self._pending: Optional[TimeoutInfo] = None
+        self._lock = threading.Lock()
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        """Replace the pending timeout iff ti is for a >= (h,r,s)
+        (reference ticker.go:100-126 timeoutRoutine)."""
+        with self._lock:
+            if self._pending is not None and self._pending.newer_than(ti):
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._pending = ti
+            self._timer = threading.Timer(
+                ti.duration_ms / 1000.0, self._fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self, ti: TimeoutInfo) -> None:
+        with self._lock:
+            if self._pending is not ti:
+                return  # superseded
+            self._pending = None
+            self._timer = None
+        self._deliver(ti)
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._pending = None
+            self._timer = None
+
+
+class ManualTicker:
+    """Virtual-clock ticker for deterministic tests."""
+
+    def __init__(self, deliver: Callable[[TimeoutInfo], None]):
+        self._deliver = deliver
+        self._pending: Optional[TimeoutInfo] = None
+        self._lock = threading.Lock()
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        with self._lock:
+            if self._pending is not None and self._pending.newer_than(ti):
+                return
+            self._pending = ti
+
+    def has_pending(self) -> bool:
+        return self._pending is not None
+
+    def fire_pending(self) -> bool:
+        """Deliver the pending timeout now; returns False if none."""
+        with self._lock:
+            ti = self._pending
+            self._pending = None
+        if ti is None:
+            return False
+        self._deliver(ti)
+        return True
+
+    def stop(self) -> None:
+        with self._lock:
+            self._pending = None

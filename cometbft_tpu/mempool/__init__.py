@@ -1,0 +1,3 @@
+from .mempool import CListMempool, Mempool, NopMempool, TxCache
+
+__all__ = ["CListMempool", "Mempool", "NopMempool", "TxCache"]

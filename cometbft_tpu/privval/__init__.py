@@ -1,0 +1,3 @@
+from .file import FilePV, DoubleSignError, PrivValidator
+
+__all__ = ["FilePV", "DoubleSignError", "PrivValidator"]

@@ -21,7 +21,14 @@ from ..crypto import batch as crypto_batch
 from .block import Commit, CommitSig, BlockID
 from .validator import ValidatorSet
 
-BATCH_VERIFY_THRESHOLD = 2  # reference types/validation.go:13
+# Minimum signature count before the device batch path pays for itself.
+# The reference sets 2 (types/validation.go:13) because its batch verifier
+# is a cheap same-thread CPU MSM; here "batch" means a TPU kernel dispatch
+# (and a one-time jit compile), so small commits — consensus rounds, tiny
+# validator sets — go through the ~50µs native single-sig path instead,
+# and the kernel serves the bulk tiles (blocksync, light client) it was
+# built for.
+BATCH_VERIFY_THRESHOLD = 64
 
 
 class CommitVerificationError(Exception):

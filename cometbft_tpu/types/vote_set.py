@@ -113,6 +113,111 @@ class VoteSet:
     def add_vote(self, vote: Optional[Vote]) -> bool:
         """Returns True if added, False for exact duplicates; raises
         VoteError otherwise (reference vote_set.go:158 AddVote)."""
+        val = self._precheck(vote)
+        if val is None:
+            return False  # exact duplicate
+        self._check_signature(vote, val)
+        return self._finish_add(vote, val)
+
+    def _check_signature(self, vote: Vote, val) -> None:
+        """The per-vote hot path (types/vote.go:235); raises on failure.
+        Shared by add_vote and add_votes' non-batched fallback."""
+        addr = vote.validator_address
+        if self.extensions_enabled:
+            if not vote.verify_vote_and_extension(self.chain_id,
+                                                  val.pub_key):
+                raise ErrVoteInvalidSignature(
+                    f"failed to verify extended vote from {addr.hex()}")
+        else:
+            if not vote.verify(self.chain_id, val.pub_key):
+                raise ErrVoteInvalidSignature(
+                    f"failed to verify vote from {addr.hex()}")
+            if vote.extension or vote.extension_signature:
+                raise VoteError("unexpected vote extension data")
+
+    def add_votes(self, votes: List[Vote]) -> List:
+        """Batched ingest: marshal every pending signature into ONE
+        device batch (the crypto/batch seam → ops/ed25519 kernel), then
+        add with per-lane verdicts — the TPU-native form of the addVote
+        hot path for gossip bursts and catch-up, where per-signature
+        host verification (~400µs on a small host core) would dominate
+        (reference crypto/ed25519/ed25519.go:208-241 batches the same
+        way for commits; here it is applied to live vote ingest).
+
+        Returns one entry per vote: True (added), False (exact
+        duplicate), or the VoteError instance that add_vote would have
+        raised (conflicts carry both votes).
+        """
+        out: List = [None] * len(votes)
+        pend = []
+        for i, v in enumerate(votes):
+            try:
+                val = self._precheck(v)
+            except VoteError as e:
+                out[i] = e
+                continue
+            if val is None:
+                out[i] = False
+                continue
+            if not self.extensions_enabled and \
+                    (v.extension or v.extension_signature):
+                out[i] = VoteError("unexpected vote extension data")
+                continue
+            pend.append((i, v, val))
+
+        if not pend:
+            return out
+        from ..crypto import batch as crypto_batch
+        from .validation import BATCH_VERIFY_THRESHOLD
+        bv = None
+        # same threshold rationale as commit verification: below it the
+        # native single-sig path beats a device dispatch
+        if not self.extensions_enabled and \
+                len(pend) >= BATCH_VERIFY_THRESHOLD:
+            bv, ok = crypto_batch.create_batch_verifier(pend[0][2].pub_key)
+            if ok and all(val.pub_key.type_() == pend[0][2].pub_key.type_()
+                          for _i, _v, val in pend):
+                for _i, v, val in pend:
+                    bv.add(val.pub_key, v.sign_bytes(self.chain_id),
+                           v.signature)
+                _, oks = bv.verify()
+            else:
+                bv = None
+        if bv is None:
+            oks = []
+            for i, v, val in pend:
+                try:
+                    self._check_signature(v, val)
+                    oks.append(True)
+                except VoteError as e:
+                    out[i] = e
+                    oks.append(False)
+
+        for (i, v, _val), sig_ok in zip(pend, oks):
+            if not sig_ok:
+                if out[i] is None:  # batched path: generic attribution
+                    out[i] = ErrVoteInvalidSignature(
+                        f"failed to verify vote from "
+                        f"{v.validator_address.hex()}")
+                continue
+            try:
+                # re-precheck: an earlier vote in THIS batch may have
+                # landed for the same validator (duplicate in one gossip
+                # burst) — without this the duplicate would hit
+                # _add_verified_vote's assertion
+                val = self._precheck(v)
+                if val is None:
+                    out[i] = False
+                    continue
+                out[i] = self._finish_add(v, val)
+            except VoteError as e:
+                out[i] = e
+        return out
+
+    def _precheck(self, vote: Optional[Vote]):
+        """Everything before the signature check (reference
+        vote_set.go:158-240): returns the validator, or None for an
+        exact duplicate; raises VoteError."""
         if vote is None:
             raise VoteError("nil vote")
         idx = vote.validator_index
@@ -142,25 +247,14 @@ class VoteSet:
         existing = self._get_vote(idx, block_key)
         if existing is not None:
             if existing.signature == vote.signature:
-                return False  # exact duplicate
+                return None  # exact duplicate
             raise ErrVoteNonDeterministicSignature(
                 f"existing vote: {existing}; new vote: {vote}")
+        return val
 
-        # signature check — the per-vote hot path (types/vote.go:235)
-        if self.extensions_enabled:
-            if not vote.verify_vote_and_extension(self.chain_id,
-                                                  val.pub_key):
-                raise ErrVoteInvalidSignature(
-                    f"failed to verify extended vote from {addr.hex()}")
-        else:
-            if not vote.verify(self.chain_id, val.pub_key):
-                raise ErrVoteInvalidSignature(
-                    f"failed to verify vote from {addr.hex()}")
-            if vote.extension or vote.extension_signature:
-                raise VoteError("unexpected vote extension data")
-
+    def _finish_add(self, vote: Vote, val) -> bool:
         added, conflicting = self._add_verified_vote(
-            vote, block_key, val.voting_power)
+            vote, vote.block_id.key(), val.voting_power)
         if conflicting is not None:
             raise ErrVoteConflictingVotes(conflicting, vote, added)
         if not added:

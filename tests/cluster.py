@@ -1,0 +1,118 @@
+"""In-process consensus cluster harness — the `common_test.go:1056` analog
+(reference internal/consensus/common_test.go): N consensus states wired
+through an in-memory broadcast fabric, no sockets, real timeout tickers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.consensus.state import (ConsensusConfig, ConsensusState)
+from cometbft_tpu.db.kv import MemDB
+from cometbft_tpu.evidence.pool import EvidencePool
+from cometbft_tpu.mempool.mempool import CListMempool
+from cometbft_tpu.privval.file import FilePV
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import GenesisDoc, State, StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.types.validator import Validator
+
+FAST_CONFIG = ConsensusConfig(
+    timeout_propose=400, timeout_propose_delta=200,
+    timeout_prevote=200, timeout_prevote_delta=100,
+    timeout_precommit=200, timeout_precommit_delta=100,
+    timeout_commit=40)
+
+
+def make_genesis(n_vals: int, chain_id: str = "tpu-cluster",
+                 power: int = 10, seed: int = 42):
+    """n FilePVs + a GenesisDoc giving each equal power."""
+    import random
+    rng = random.Random(seed)
+    pvs = [FilePV.generate(None, rng) for _ in range(n_vals)]
+    vals = [Validator(pv.get_pub_key(), power) for pv in pvs]
+    # deterministic ordering (reference sorts validator sets by address)
+    order = sorted(range(n_vals), key=lambda i: vals[i].address)
+    return ([pvs[i] for i in order],
+            GenesisDoc(chain_id=chain_id, validators=[vals[i] for i in order]))
+
+
+class Node:
+    """One in-process validator node: app + stores + mempool + consensus."""
+
+    def __init__(self, gen: GenesisDoc, pv: Optional[FilePV],
+                 config: ConsensusConfig = FAST_CONFIG,
+                 wal=None, name: str = ""):
+        self.app = KVStoreApplication()
+        self.app.init_chain(gen.chain_id, gen.initial_height,
+                            gen.validators, gen.app_state)
+        self.block_store = BlockStore(MemDB())
+        self.state_store = StateStore(MemDB())
+        self.mempool = CListMempool(
+            lambda tx: (self.app.check_tx(tx).code, 0))
+        self.evidence_pool = EvidencePool(
+            state_store=self.state_store, block_store=self.block_store)
+        state = State.from_genesis(gen)
+        self.executor = BlockExecutor(
+            self.app, state_store=self.state_store,
+            block_store=self.block_store, mempool=self.mempool,
+            evidence_pool=self.evidence_pool)
+        self.cs = ConsensusState(
+            config, state, self.executor, self.block_store,
+            priv_validator=pv, wal=wal, name=name)
+        self.cs.evidence_pool = self.evidence_pool
+        self.commits: List = []
+        self.commit_event = threading.Event()
+
+        def on_commit(block, commit):
+            self.commits.append((block, commit))
+            self.commit_event.set()
+        self.cs.on_commit = on_commit
+
+
+class Cluster:
+    """Full-mesh instant-delivery fabric (reference p2p/test_util.go's
+    in-memory switch, simplified to direct inbox delivery)."""
+
+    def __init__(self, n_vals: int, config: ConsensusConfig = FAST_CONFIG,
+                 chain_id: str = "tpu-cluster", wal_factory=None,
+                 drop: Optional[Callable[[int, int, object], bool]] = None):
+        self.pvs, self.gen = make_genesis(n_vals, chain_id)
+        self.nodes: List[Node] = []
+        self.drop = drop or (lambda src, dst, msg: False)
+        for i, pv in enumerate(self.pvs):
+            wal = wal_factory(i) if wal_factory else None
+            self.nodes.append(Node(self.gen, pv, config, wal, name=str(i)))
+        for i, node in enumerate(self.nodes):
+            node.cs.broadcast = self._broadcaster(i)
+
+    def _broadcaster(self, src: int):
+        def broadcast(msg):
+            for j, other in enumerate(self.nodes):
+                if j != src and not self.drop(src, j, msg):
+                    other.cs.send(msg, peer_id=f"node{src}")
+        return broadcast
+
+    def start(self) -> None:
+        for node in self.nodes:
+            node.cs.start()
+
+    def stop(self) -> None:
+        for node in self.nodes:
+            node.cs.stop()
+
+    def wait_for_height(self, height: int, timeout: float = 60.0) -> None:
+        """Block until every node has committed `height`."""
+        deadline = time.monotonic() + timeout
+        for node in self.nodes:
+            while node.cs.state.last_block_height < height:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"node {node.cs.name} stuck at "
+                        f"{node.cs.state.last_block_height} "
+                        f"(rs: h={node.cs.rs.height} r={node.cs.rs.round} "
+                        f"s={node.cs.rs.step})")
+                time.sleep(0.01)

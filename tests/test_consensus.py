@@ -1,0 +1,223 @@
+"""Consensus state machine tests: multi-validator commit progression,
+round skipping on proposer silence, WAL crash/replay, privval double-sign
+refusal (reference internal/consensus/state_test.go, replay_test.go,
+common_test.go patterns)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from cluster import Cluster, FAST_CONFIG, Node, make_genesis
+from cometbft_tpu.consensus.state import (
+    ConsensusConfig, ProposalMessage, VoteMessage, STEP_NEW_HEIGHT)
+from cometbft_tpu.consensus.wal import (
+    WAL, EndHeightMessage, WALVote, WALTimeout)
+from cometbft_tpu.privval.file import DoubleSignError, FilePV
+from cometbft_tpu.types.vote import Vote, Proposal, PREVOTE_TYPE
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.proto import Timestamp
+
+
+def test_four_validators_commit_blocks():
+    """The `common_test` happy path: 4 validators commit a chain."""
+    c = Cluster(4)
+    try:
+        c.start()
+        c.wait_for_height(5, timeout=90)
+        # all nodes agree on every committed block hash
+        for h in range(1, 6):
+            hashes = {n.block_store.load_block(h).hash() for n in c.nodes}
+            assert len(hashes) == 1, f"fork at height {h}"
+        # app state agrees
+        app_hashes = {n.cs.state.app_hash for n in c.nodes}
+        assert len(app_hashes) == 1
+    finally:
+        c.stop()
+
+
+def test_commit_with_transactions():
+    """Txs submitted to mempools are committed and executed."""
+    c = Cluster(4)
+    try:
+        c.start()
+        c.wait_for_height(1, timeout=60)
+        for node in c.nodes:
+            node.mempool.check_tx(b"alpha=1")
+        c.nodes[0].mempool.check_tx(b"bravo=2")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(n.app.query("/store", b"alpha")[1] == b"1"
+                   and n.app.query("/store", b"bravo")[1] == b"2"
+                   for n in c.nodes):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError("txs never executed on all nodes")
+        # committed txs left every mempool
+        for n in c.nodes:
+            assert not n.mempool.contains(
+                __import__("cometbft_tpu.mempool.mempool",
+                           fromlist=["tx_key"]).tx_key(b"alpha=1"))
+    finally:
+        c.stop()
+
+
+def test_round_skip_when_proposer_down():
+    """Height advances past a silent proposer via round > 0 (reference
+    state_test.go proposer-timeout behavior)."""
+    # drop every message from/to node holding proposer slot at h1 r0 by
+    # simply not starting one node (3 of 4 = 30/40 power > 2/3)
+    c = Cluster(4)
+    try:
+        for node in c.nodes[1:]:
+            node.cs.start()
+        # nodes must keep committing without node 0 (rounds where node 0
+        # is proposer time out and advance)
+        deadline = time.monotonic() + 120
+        for node in c.nodes[1:]:
+            while node.cs.state.last_block_height < 3:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"stuck: h={node.cs.state.last_block_height} "
+                        f"rs={node.cs.rs.height}/{node.cs.rs.round}")
+                time.sleep(0.01)
+        rounds_used = {n.commits[0][1].round for n in c.nodes[1:]}
+        assert rounds_used  # commits exist; round may be 0 or higher
+    finally:
+        c.stop()
+
+
+def test_wal_records_and_replay(tmp_path):
+    """Kill a node mid-height; a fresh ConsensusState over the same WAL
+    replays to the same (height, round) without double-signing
+    (reference replay_test.go kill-and-restart classes)."""
+    wal_paths = {i: str(tmp_path / f"wal{i}.log") for i in range(4)}
+    c = Cluster(4, wal_factory=lambda i: WAL(wal_paths[i]))
+    try:
+        c.start()
+        c.wait_for_height(3, timeout=90)
+    finally:
+        c.stop()
+
+    # WAL sanity: every node logged an ENDHEIGHT for each committed height
+    for i in range(4):
+        msgs = list(WAL(wal_paths[i]).iter_messages())
+        ends = [m.height for m in msgs if isinstance(m, EndHeightMessage)]
+        assert ends == sorted(ends)
+        assert set(ends) >= {1, 2, 3}
+        assert any(isinstance(m, WALVote) for m in msgs)
+
+    # crash-replay: rebuild node 0 from genesis state + its WAL; replay
+    # must fast-forward through recorded votes without re-signing
+    # conflicts (the privval state also survived)
+    node0 = c.nodes[0]
+    pv = c.pvs[0]
+    from cometbft_tpu.state.state import State
+    fresh = Node(c.gen, pv, FAST_CONFIG, wal=WAL(wal_paths[0]), name="r0")
+    # replay the chain through the executor first (blocks are in the
+    # original store; handshake replay is modeled by re-applying)
+    state = State.from_genesis(c.gen)
+    for h in range(1, node0.cs.state.last_block_height + 1):
+        blk = node0.block_store.load_block(h)
+        parts = blk.make_part_set()
+        bid = BlockID(blk.hash(), parts.header)
+        state, _ = fresh.executor.apply_block(state, bid, blk, verified=True)
+    fresh.cs.state = state
+    fresh.cs._update_to_state(state)
+    fresh.cs.catchup_replay()  # must not raise / double-sign
+    assert fresh.cs.rs.height == state.last_block_height + 1
+
+
+def test_wal_torn_tail_truncated(tmp_path):
+    path = str(tmp_path / "wal.log")
+    w = WAL(path)
+    w.write_sync(EndHeightMessage(1))
+    w.write(WALTimeout(2, 0, 3, 1000))
+    w.close()
+    # simulate crash mid-append
+    with open(path, "ab") as f:
+        f.write(b"\x01\x02\x03garbage")
+    w2 = WAL(path)
+    msgs = list(w2.iter_messages())
+    assert msgs == [EndHeightMessage(1), WALTimeout(2, 0, 3, 1000)]
+    # appends after recovery land cleanly
+    w2.write_sync(EndHeightMessage(2))
+    assert list(WAL(path).iter_messages())[-1] == EndHeightMessage(2)
+
+
+def test_privval_double_sign_guard(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "pv.json"))
+    pv._save()
+    bid_a = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+    bid_b = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xdd" * 32))
+    v1 = Vote(type_=PREVOTE_TYPE, height=5, round=0, block_id=bid_a,
+              timestamp=Timestamp(100, 0),
+              validator_address=pv.address(), validator_index=0)
+    pv.sign_vote("chain", v1)
+    assert v1.signature
+
+    # same HRS, same block, later timestamp -> same signature re-released
+    v2 = Vote(type_=PREVOTE_TYPE, height=5, round=0, block_id=bid_a,
+              timestamp=Timestamp(101, 0),
+              validator_address=pv.address(), validator_index=0)
+    pv.sign_vote("chain", v2)
+    assert v2.signature == v1.signature
+
+    # same HRS, DIFFERENT block -> refused
+    v3 = Vote(type_=PREVOTE_TYPE, height=5, round=0, block_id=bid_b,
+              timestamp=Timestamp(100, 0),
+              validator_address=pv.address(), validator_index=0)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote("chain", v3)
+
+    # height regression -> refused, even after reload from disk
+    pv2 = FilePV.load(str(tmp_path / "pv.json"))
+    v4 = Vote(type_=PREVOTE_TYPE, height=4, round=0, block_id=bid_a,
+              timestamp=Timestamp(100, 0),
+              validator_address=pv2.address(), validator_index=0)
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote("chain", v4)
+
+
+def test_byzantine_double_sign_surfaces_conflict():
+    """A scripted equivocating vote shows up as conflicting-vote material
+    on honest nodes (the evidence feedstock, reference
+    byzantine_test.go)."""
+    c = Cluster(4)
+    try:
+        c.start()
+        c.wait_for_height(2, timeout=90)
+
+        # craft an equivocation: byz validator signs a prevote for a
+        # bogus block at the current height/round of node 1's view
+        byz_pv = c.pvs[3]
+        target = c.nodes[1].cs
+        h, r = target.rs.height, target.rs.round
+        state_vals = target.state.validators
+        idx, _ = state_vals.get_by_address(byz_pv.address())
+        fake = Vote(type_=PREVOTE_TYPE, height=h, round=r,
+                    block_id=BlockID(b"\xee" * 32,
+                                     PartSetHeader(1, b"\xff" * 32)),
+                    timestamp=Timestamp.now(),
+                    validator_address=byz_pv.address(),
+                    validator_index=idx)
+        # bypass the guard the way a malicious binary would
+        sb = fake.sign_bytes(c.gen.chain_id)
+        fake.signature = byz_pv.priv_key.sign(sb)
+        target.send(VoteMessage(fake), peer_id="byz")
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if target.conflicting_votes:
+                err = target.conflicting_votes[0]
+                assert err.vote_a.validator_address == byz_pv.address()
+                break
+            # keep the height advancing so the real vote also arrives
+            time.sleep(0.02)
+            if target.rs.height > h + 2:
+                break
+        assert target.conflicting_votes, "conflict never detected"
+    finally:
+        c.stop()
