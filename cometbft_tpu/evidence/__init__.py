@@ -1,0 +1,3 @@
+from .pool import EvidencePool, verify_duplicate_vote
+
+__all__ = ["EvidencePool", "verify_duplicate_vote"]

@@ -1,0 +1,168 @@
+"""Evidence pool: collect, verify, store, and serve Byzantine-fault
+evidence (reference internal/evidence/pool.go:142-308, verify.go:110-210).
+
+Consensus feeds it conflicting votes (ErrVoteConflictingVotes →
+add_duplicate_vote); the proposer reaps pending evidence into blocks;
+committed evidence is marked and pruned once outside the evidence
+age window (ConsensusParams.evidence_max_age_*).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..state.state import State
+from ..types.evidence import (DuplicateVoteEvidence, EvidenceError)
+from ..types.proto import Timestamp
+from ..types.vote import Vote
+
+
+def verify_duplicate_vote(ev: DuplicateVoteEvidence, state: State,
+                          val_set) -> None:
+    """reference internal/evidence/verify.go:164-210 VerifyDuplicateVote.
+
+    val_set must be the validator set AT the evidence height. Raises
+    EvidenceError if invalid.
+    """
+    ev.validate_basic()
+    a, b = ev.vote_a, ev.vote_b
+    if a.height != b.height or a.round != b.round or \
+            a.type_ != b.type_:
+        raise EvidenceError("votes from different HRS")
+    if a.validator_address != b.validator_address:
+        raise EvidenceError("votes from different validators")
+    if a.block_id.key() == b.block_id.key():
+        raise EvidenceError("votes for the same block")
+
+    idx, val = val_set.get_by_address(a.validator_address)
+    if val is None:
+        raise EvidenceError(
+            f"address {a.validator_address.hex()} not in validator set "
+            f"at height {a.height}")
+    if a.validator_index != idx or b.validator_index != idx:
+        raise EvidenceError("wrong validator index")
+
+    # power bookkeeping must match what the header committed to
+    if ev.validator_power != val.voting_power:
+        raise EvidenceError(
+            f"evidence validator power {ev.validator_power} != "
+            f"{val.voting_power}")
+    if ev.total_voting_power != val_set.total_voting_power():
+        raise EvidenceError("evidence total power mismatch")
+
+    chain_id = state.chain_id
+    for v in (a, b):
+        if not val.pub_key.verify_signature(
+                v.sign_bytes(chain_id), v.signature):
+            raise EvidenceError("invalid signature on duplicate vote")
+
+
+class EvidencePool:
+    """reference internal/evidence/pool.go Pool."""
+
+    def __init__(self, state_store=None, block_store=None):
+        self.state_store = state_store
+        self.block_store = block_store
+        self._pending: List[DuplicateVoteEvidence] = []
+        self._committed: set = set()
+        self._seen: set = set()
+        self._lock = threading.RLock()
+
+    # --- intake --------------------------------------------------------------
+
+    def add_duplicate_vote(self, vote_a: Vote, vote_b: Vote,
+                           state: State) -> Optional[DuplicateVoteEvidence]:
+        """Consensus-discovered conflict (reference pool.go:142
+        AddEvidence via state.go tryAddVote)."""
+        val_set = self._validators_at(vote_a.height, state)
+        if val_set is None:
+            return None
+        try:
+            ev = DuplicateVoteEvidence.from_conflict(
+                vote_a, vote_b, val_set, state.last_block_time)
+            return self.add_evidence(ev, state)
+        except EvidenceError:
+            return None
+
+    def add_evidence(self, ev: DuplicateVoteEvidence, state: State
+                     ) -> Optional[DuplicateVoteEvidence]:
+        """Verify + admit (gossiped or consensus-local)."""
+        with self._lock:
+            key = ev.hash()
+            if key in self._seen or key in self._committed:
+                return None
+            val_set = self._validators_at(ev.height(), state)
+            if val_set is None:
+                return None
+            if self._expired(ev, state):
+                return None
+            verify_duplicate_vote(ev, state, val_set)
+            self._pending.append(ev)
+            self._seen.add(key)
+            return ev
+
+    def _validators_at(self, height: int, state: State):
+        if height == state.last_block_height + 1:
+            return state.validators
+        if height == state.last_block_height:
+            return state.last_validators
+        if self.state_store is not None:
+            return self.state_store.load_validators(height)
+        return None
+
+    def _expired(self, ev, state: State) -> bool:
+        """reference pool.go isExpired: beyond BOTH age bounds."""
+        p = state.consensus_params
+        age_blocks = state.last_block_height - ev.height()
+        age_secs = (state.last_block_time.seconds - ev.time().seconds)
+        return (age_blocks > p.evidence_max_age_num_blocks
+                and age_secs > p.evidence_max_age_seconds)
+
+    # --- proposal / commit flow ---------------------------------------------
+
+    def pending_evidence(self, max_bytes: int = -1
+                         ) -> List[DuplicateVoteEvidence]:
+        """reference pool.go:100 PendingEvidence (byte-bounded reap)."""
+        with self._lock:
+            out, total = [], 0
+            for ev in self._pending:
+                sz = len(ev.encode())
+                if max_bytes >= 0 and total + sz > max_bytes:
+                    break
+                out.append(ev)
+                total += sz
+            return out
+
+    def check_evidence(self, evs: List[DuplicateVoteEvidence],
+                       state: State) -> None:
+        """Block-validation hook: every piece must verify (reference
+        pool.go:308 CheckEvidence). Raises EvidenceError."""
+        seen_in_block = set()
+        for ev in evs:
+            key = ev.hash()
+            if key in seen_in_block:
+                raise EvidenceError("duplicate evidence in block")
+            seen_in_block.add(key)
+            if key in self._committed:
+                raise EvidenceError("evidence already committed")
+            val_set = self._validators_at(ev.height(), state)
+            if val_set is None:
+                raise EvidenceError(
+                    f"no validator set for evidence height {ev.height()}")
+            verify_duplicate_vote(ev, state, val_set)
+
+    def update(self, state: State,
+               committed: List[DuplicateVoteEvidence]) -> None:
+        """Post-commit: mark included evidence, prune expired (reference
+        pool.go:80 Update)."""
+        with self._lock:
+            for ev in committed:
+                self._committed.add(ev.hash())
+            self._pending = [
+                ev for ev in self._pending
+                if ev.hash() not in self._committed
+                and not self._expired(ev, state)]
+
+    def size(self) -> int:
+        return len(self._pending)

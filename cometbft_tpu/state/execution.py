@@ -45,6 +45,8 @@ def validate_block(state: State, block: Block,
         raise BlockValidationError("wrong last_commit_hash")
     if h.data_hash != block.data.hash():
         raise BlockValidationError("wrong data_hash")
+    if h.evidence_hash != block.evidence_hash():
+        raise BlockValidationError("wrong evidence_hash")
     if h.validators_hash != state.validators.hash():
         raise BlockValidationError("wrong validators_hash")
     if h.next_validators_hash != state.next_validators.hash():
@@ -106,12 +108,21 @@ class BlockExecutor:
                               proposer_address: bytes) -> Block:
         """reference state/execution.go:109-166."""
         max_bytes = state.consensus_params.max_block_bytes
+        evidence = []
+        if self.evidence_pool is not None:
+            evidence = self.evidence_pool.pending_evidence(
+                state.consensus_params.evidence_max_bytes)
+        # evidence shares the block byte budget with txs (reference
+        # types.MaxDataBytes, state/execution.go:126-133)
+        ev_bytes = sum(len(ev.encode()) + 8 for ev in evidence)
+        data_budget = max(0, max_bytes - 2048 - ev_bytes)
         txs: List[bytes] = []
         if self.mempool is not None:
             txs = self.mempool.reap_max_bytes_max_gas(
-                max_bytes - 2048, state.consensus_params.max_gas)
-        txs = self.app.prepare_proposal(txs, max_bytes - 2048)
-        return state.make_block(height, txs, last_commit, proposer_address)
+                data_budget, state.consensus_params.max_gas)
+        txs = self.app.prepare_proposal(txs, data_budget)
+        return state.make_block(height, txs, last_commit, proposer_address,
+                                evidence=evidence)
 
     def process_proposal(self, block: Block, state: State) -> bool:
         """reference state/execution.go:169-196."""
@@ -122,6 +133,12 @@ class BlockExecutor:
     def validate_block(self, state: State, block: Block,
                        check_commit: bool = True) -> None:
         validate_block(state, block, check_commit=check_commit)
+        if self.evidence_pool is not None and block.evidence:
+            from ..types.evidence import EvidenceError
+            try:
+                self.evidence_pool.check_evidence(block.evidence, state)
+            except EvidenceError as e:
+                raise BlockValidationError(f"invalid evidence: {e}") from e
 
     def apply_block(self, state: State, block_id: BlockID, block: Block,
                     verified: bool = False) -> Tuple[State, ResponseFinalizeBlock]:
@@ -159,6 +176,9 @@ class BlockExecutor:
         finally:
             if self.mempool is not None:
                 self.mempool.unlock()
+
+        if self.evidence_pool is not None:
+            self.evidence_pool.update(new_state, list(block.evidence))
 
         if self.state_store is not None:
             self.state_store.save(new_state)

@@ -99,12 +99,15 @@ class State:
 
     def make_block(self, height: int, txs: List[bytes], last_commit: Commit,
                    proposer_address: bytes,
-                   timestamp: Optional[Timestamp] = None) -> Block:
+                   timestamp: Optional[Timestamp] = None,
+                   evidence: Optional[list] = None) -> Block:
         """reference state/state.go:233-263."""
+        from ..types.evidence import EvidenceList
         if timestamp is None:
             timestamp = (self.last_block_time if height == self.initial_height
                          else Timestamp.now())
         data = Data(txs=list(txs))
+        evidence = list(evidence or [])
         header = Header(
             version_block=self.version_block,
             version_app=self.version_app,
@@ -119,10 +122,11 @@ class State:
             consensus_hash=self.consensus_params.hash(),
             app_hash=self.app_hash,
             last_results_hash=self.last_results_hash,
-            evidence_hash=merkle.hash_from_byte_slices([]),
+            evidence_hash=EvidenceList(evidence).hash(),
             proposer_address=proposer_address,
         )
-        return Block(header=header, data=data, last_commit=last_commit)
+        return Block(header=header, data=data, evidence=evidence,
+                     last_commit=last_commit)
 
 
 class StateStore:

@@ -342,24 +342,33 @@ class Block:
     def encode(self) -> bytes:
         """proto Block (block.proto: header=1, data=2, evidence=3,
         last_commit=4)."""
+        from .evidence import EvidenceList
         out = (proto.f_embed(1, self.header.encode())
                + proto.f_embed(2, self.data.encode())
-               + proto.f_embed(3, b""))  # evidence list (wired in later)
+               + proto.f_embed(3, EvidenceList(self.evidence).encode()))
         out += proto.f_embed(4, self.last_commit.encode())
         return out
 
     @classmethod
     def decode(cls, buf: bytes) -> "Block":
+        from .evidence import EvidenceList
         f = proto.parse_fields(buf)
         hdr = proto.field_bytes(f, 1, None)
         if hdr is None:
             raise ValueError("block without header")
         data = proto.field_bytes(f, 2, None)
+        ev = proto.field_bytes(f, 3, None)
         lc = proto.field_bytes(f, 4, None)
         return cls(header=Header.decode(hdr),
                    data=Data.decode(data) if data is not None else Data(),
+                   evidence=(list(EvidenceList.decode(ev).evidence)
+                             if ev is not None else []),
                    last_commit=Commit.decode(lc) if lc is not None
                    else Commit())
+
+    def evidence_hash(self) -> bytes:
+        from .evidence import EvidenceList
+        return EvidenceList(self.evidence).hash()
 
     def make_part_set(self, part_size: int = BLOCK_PART_SIZE) -> "PartSet":
         return PartSet.from_data(self.encode(), part_size)
@@ -370,6 +379,30 @@ class Part:
     index: int
     bytes_: bytes
     proof: merkle.Proof
+
+    def encode(self) -> bytes:
+        """proto Part (types.proto): index=1, bytes=2, proof=3
+        {total=1, index=2, leaf_hash=3, aunts=4 repeated}."""
+        pf = (proto.f_varint(1, self.proof.total)
+              + proto.f_varint(2, self.proof.index)
+              + proto.f_bytes(3, self.proof.leaf_hash)
+              + b"".join(proto.f_bytes(4, a) for a in self.proof.aunts))
+        return (proto.f_varint(1, self.index)
+                + proto.f_bytes(2, self.bytes_)
+                + proto.f_embed(3, pf))
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Part":
+        f = proto.parse_fields(buf)
+        pf = proto.parse_fields(proto.field_bytes(f, 3, b""))
+        return cls(
+            index=proto.field_int(f, 1, 0),
+            bytes_=proto.field_bytes(f, 2, b""),
+            proof=merkle.Proof(
+                total=proto.to_int64(proto.field_int(pf, 1, 0)),
+                index=proto.to_int64(proto.field_int(pf, 2, 0)),
+                leaf_hash=proto.field_bytes(pf, 3, b""),
+                aunts=proto.field_all_bytes(pf, 4)))
 
 
 class PartSet:
