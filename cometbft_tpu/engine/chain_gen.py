@@ -7,7 +7,7 @@ test/e2e's generator play).
 from __future__ import annotations
 
 import random
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..abci.application import Application
@@ -32,6 +32,7 @@ class GeneratedChain:
     block_ids: List[BlockID]
     seen_commits: List[Commit]           # commit sealing each height
     keys: Dict[bytes, Ed25519PrivKey]    # address -> key
+    valsets: List = dc_field(default_factory=list)  # signer set per height
 
     def max_height(self) -> int:
         return len(self.blocks)
@@ -91,6 +92,7 @@ def generate_chain(n_blocks: int, n_validators: int = 4,
     blocks: List[Block] = []
     block_ids: List[BlockID] = []
     commits: List[Commit] = []
+    valsets: List = []
     last_commit = Commit()
     for h in range(1, n_blocks + 1):
         txs = [f"k{h}-{i}=v{h}-{i}".encode() for i in range(txs_per_block)]
@@ -102,6 +104,7 @@ def generate_chain(n_blocks: int, n_validators: int = 4,
             timestamp=Timestamp(1_700_000_000 + h, 0))
         block_id = BlockID(block.hash(), block.make_part_set().header)
         commit = sign_commit(chain_id, h, 0, block_id, state.validators, keys)
+        valsets.append(state.validators.copy())
         state, _ = executor.apply_block(state, block_id, block)
         blocks.append(block)
         block_ids.append(block_id)
@@ -109,7 +112,7 @@ def generate_chain(n_blocks: int, n_validators: int = 4,
         last_commit = commit
     return GeneratedChain(chain_id=chain_id, genesis=gen, blocks=blocks,
                           block_ids=block_ids, seen_commits=commits,
-                          keys=keys)
+                          keys=keys, valsets=valsets)
 
 
 class LocalChainSource:

@@ -1,0 +1,7 @@
+from .client import LightClient, TrustOptions, LightClientError
+from .types import LightBlock, SignedHeader
+from .store import LightStore
+from .provider import Provider, BlockStoreProvider
+
+__all__ = ["LightClient", "TrustOptions", "LightClientError", "LightBlock",
+           "SignedHeader", "LightStore", "Provider", "BlockStoreProvider"]

@@ -1,0 +1,216 @@
+"""Light client: sequential + skipping (bisection) verification with
+witness cross-checking (reference light/client.go:473,612,705,
+light/detector.go).
+
+The third north-star call site: on a 10k-header catch-up, each header's
+commit flows through the same batch-verify seam the blocksync tile uses,
+so bulk light verification rides the TPU kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..types.proto import Timestamp
+from ..types import validation
+from . import verifier
+from .provider import Provider, ProviderError
+from .store import LightStore
+from .types import LightBlock, LightBlockError
+
+
+class LightClientError(Exception):
+    pass
+
+
+class ErrNoWitnesses(LightClientError):
+    pass
+
+
+@dataclass
+class ConflictingHeadersError(LightClientError):
+    """A witness returned a different header for a verified height — the
+    divergence the detector reports as a light-client attack (reference
+    light/detector.go:21-92)."""
+    primary: LightBlock
+    witness: LightBlock
+    witness_index: int
+
+    def __str__(self) -> str:
+        return (f"witness {self.witness_index} disagrees at height "
+                f"{self.primary.height}")
+
+
+@dataclass
+class TrustOptions:
+    """reference light/client.go:58-90."""
+    period_seconds: int
+    height: int
+    hash: bytes
+
+    def validate(self) -> None:
+        if self.period_seconds <= 0:
+            raise LightClientError("trusting period must be positive")
+        if self.height <= 0:
+            raise LightClientError("trusted height must be positive")
+        if len(self.hash) != 32:
+            raise LightClientError("trusted hash must be 32 bytes")
+
+
+class LightClient:
+    """reference light/client.go Client (sequential=False selects
+    skipping/bisection, the default)."""
+
+    def __init__(self, chain_id: str, trust_options: TrustOptions,
+                 primary: Provider, witnesses: List[Provider],
+                 store: LightStore, sequential: bool = False,
+                 trust_level: validation.Fraction =
+                 validation.DEFAULT_TRUST_LEVEL,
+                 now_fn=Timestamp.now):
+        trust_options.validate()
+        self.chain_id = chain_id
+        self.trusting_period = trust_options.period_seconds
+        self.primary = primary
+        self.witnesses = list(witnesses)
+        self.store = store
+        self.sequential = sequential
+        self.trust_level = trust_level
+        self._now = now_fn
+        self._initialize(trust_options)
+
+    def _initialize(self, opts: TrustOptions) -> None:
+        """Fetch + pin the trust root (reference client.go:388-470
+        initializeWithTrustOptions)."""
+        existing = self.store.light_block(opts.height)
+        if existing is not None:
+            if existing.header.hash() != opts.hash:
+                raise LightClientError(
+                    "trusted hash does not match stored header")
+            return
+        lb = self.primary.light_block(opts.height)
+        lb.validate_basic(self.chain_id)
+        if lb.header.hash() != opts.hash:
+            raise LightClientError(
+                f"primary returned header hash "
+                f"{lb.header.hash().hex()[:16]} != trusted "
+                f"{opts.hash.hex()[:16]}")
+        # the set that signed must be the one committed to by the header
+        validation.verify_commit_light(
+            self.chain_id, lb.validator_set,
+            lb.signed_header.commit.block_id, lb.height,
+            lb.signed_header.commit)
+        self.store.save_light_block(lb)
+
+    # --- public API -----------------------------------------------------------
+
+    def trusted_light_block(self, height: int) -> Optional[LightBlock]:
+        return self.store.light_block(height)
+
+    def latest_trusted(self) -> Optional[LightBlock]:
+        return self.store.latest()
+
+    def update(self, now: Optional[Timestamp] = None) -> LightBlock:
+        """Verify the primary's latest header (reference client.go:506)."""
+        latest = self.primary.light_block(0)
+        return self.verify_light_block_at_height(latest.height, now)
+
+    def verify_light_block_at_height(self, height: int,
+                                     now: Optional[Timestamp] = None
+                                     ) -> LightBlock:
+        """reference light/client.go:473-504."""
+        now = now or self._now()
+        got = self.store.light_block(height)
+        if got is not None:
+            return got
+        latest = self.store.latest()
+        if latest is None:
+            raise LightClientError("store empty — client not initialized")
+        if height < latest.height:
+            # backwards verification (reference client.go:934): walk the
+            # hash links down from the closest trusted header
+            return self._verify_backwards(height)
+        lb = self.primary.light_block(height)
+        lb.validate_basic(self.chain_id)
+        if self.sequential:
+            self._verify_sequential(latest, lb, now)
+        else:
+            self._verify_skipping(latest, lb, now)
+        self._cross_check(lb)
+        self.store.save_light_block(lb)
+        return lb
+
+    # --- verification strategies ----------------------------------------------
+
+    def _verify_sequential(self, trusted: LightBlock, target: LightBlock,
+                           now: Timestamp) -> None:
+        """reference light/client.go:612-668: fetch and verify EVERY
+        header between trusted and target."""
+        cur = trusted
+        for h in range(trusted.height + 1, target.height + 1):
+            nxt = (target if h == target.height
+                   else self.primary.light_block(h))
+            nxt.validate_basic(self.chain_id)
+            verifier.verify_adjacent(
+                self.chain_id, cur, nxt, self.trusting_period, now)
+            self.store.save_light_block(nxt)
+            cur = nxt
+
+    def _verify_skipping(self, trusted: LightBlock, target: LightBlock,
+                         now: Timestamp) -> None:
+        """Bisection (reference light/client.go:705-772 verifySkipping):
+        try the jump; when the trusted set can't vouch (<1/3 overlap),
+        bisect toward the trusted header until it can."""
+        cur = trusted
+        pivots = [target]
+        while pivots:
+            candidate = pivots[-1]
+            try:
+                if candidate.height == cur.height + 1:
+                    verifier.verify_adjacent(
+                        self.chain_id, cur, candidate,
+                        self.trusting_period, now)
+                else:
+                    verifier.verify_non_adjacent(
+                        self.chain_id, cur, candidate,
+                        self.trusting_period, now, self.trust_level)
+            except verifier.ErrNewValSetCantBeTrusted:
+                mid = (cur.height + candidate.height) // 2
+                if mid in (cur.height, candidate.height):
+                    raise LightClientError(
+                        "bisection cannot make progress")
+                lb = self.primary.light_block(mid)
+                lb.validate_basic(self.chain_id)
+                pivots.append(lb)
+                continue
+            self.store.save_light_block(candidate)
+            cur = candidate
+            pivots.pop()
+
+    def _verify_backwards(self, height: int) -> LightBlock:
+        """Hash-linked walk to an earlier height (client.go:934-988)."""
+        cur = self.store.lowest()
+        while cur is not None and cur.height > height:
+            prev = self.primary.light_block(cur.height - 1)
+            prev.validate_basic(self.chain_id)
+            if cur.header.last_block_id.hash != prev.header.hash():
+                raise LightClientError(
+                    f"backwards hash mismatch at {prev.height}")
+            self.store.save_light_block(prev)
+            cur = prev
+        if cur is None or cur.height != height:
+            raise LightClientError(f"cannot reach height {height}")
+        return cur
+
+    # --- detector ---------------------------------------------------------------
+
+    def _cross_check(self, lb: LightBlock) -> None:
+        """Compare the verified header against every witness (reference
+        light/detector.go:21-92, compareNewHeaderWithWitness)."""
+        for i, w in enumerate(self.witnesses):
+            try:
+                other = w.light_block(lb.height)
+            except ProviderError:
+                continue  # witness lagging — reference retries/drops
+            if other.header.hash() != lb.header.hash():
+                raise ConflictingHeadersError(lb, other, i)

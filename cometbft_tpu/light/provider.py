@@ -1,0 +1,52 @@
+"""Light-block providers (reference light/provider/provider.go interface,
+light/provider/http, light/provider/mock).
+
+`BlockStoreProvider` serves light blocks straight from a full node's
+BlockStore + StateStore — the in-process analog of the RPC provider, and
+what the `light/client_benchmark_test.go:24` mock provider does with its
+1000-block chain.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+from .types import LightBlock, SignedHeader
+
+
+class ProviderError(Exception):
+    pass
+
+
+class ErrLightBlockNotFound(ProviderError):
+    pass
+
+
+class Provider(Protocol):
+    """reference light/provider/provider.go:9-32."""
+
+    def chain_id(self) -> str: ...
+    def light_block(self, height: int) -> LightBlock:
+        """height 0 means latest. Raises ProviderError."""
+
+
+class BlockStoreProvider:
+    def __init__(self, chain_id: str, block_store, state_store):
+        self._chain_id = chain_id
+        self._blocks = block_store
+        self._states = state_store
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            height = self._blocks.height()
+        meta = self._blocks.load_block_meta(height)
+        blk = self._blocks.load_block(height)
+        commit = self._blocks.load_block_commit(height)
+        vals = self._states.load_validators(height)
+        if blk is None or commit is None or vals is None:
+            raise ErrLightBlockNotFound(
+                f"no light block at height {height}")
+        return LightBlock(SignedHeader(blk.header, commit), vals)
