@@ -1,0 +1,7 @@
+from .conn import SecretConnection
+from .mconn import MConnection, ChannelDescriptor
+from .switch import Switch, Peer, Reactor
+from .transport import Transport, NodeInfo
+
+__all__ = ["SecretConnection", "MConnection", "ChannelDescriptor",
+           "Switch", "Peer", "Reactor", "Transport", "NodeInfo"]

@@ -1,0 +1,165 @@
+"""SecretConnection: authenticated-encrypted peer links
+(reference p2p/conn/secret_connection.go:61-224).
+
+Station-to-station over X25519 ECDH + HKDF-SHA256 + ChaCha20-Poly1305,
+with an ed25519 identity signature over the handshake transcript:
+
+  1. exchange ephemeral X25519 public keys (32 raw bytes each way)
+  2. shared = X25519(eph_priv, peer_eph_pub); derive two 32-byte AEAD
+     keys + a 32-byte challenge via HKDF(shared, transcript-hash)
+     (the reference derives recv/send keys + challenge the same shape,
+     secret_connection.go deriveSecretAndChallenge)
+  3. each side sends AEAD-sealed AuthSig{ed25519 pubkey, sig(challenge)}
+     and checks the peer's — binding the channel keys to node identity
+     (the authenticate-then-encrypt of the STS protocol)
+  4. frames: u32-LE length || AEAD ciphertext of up to 1024-byte chunks,
+     nonces = 96-bit LE counters, one counter per direction
+     (secret_connection.go:58 dataMaxSize/frame layout).
+
+Key order is broken symmetrically by sorting the two ephemeral pubkeys
+(lowest key's owner uses key #1 to send), exactly the reference's
+rule (secret_connection.go:329-339).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey, X25519PublicKey)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives import hashes
+
+from ..crypto.keys import Ed25519PrivKey, Ed25519PubKey
+from ..types import proto
+
+DATA_MAX_SIZE = 1024  # reference p2p/conn/secret_connection.go:58
+
+
+class HandshakeError(Exception):
+    pass
+
+
+def _hkdf(shared: bytes, transcript: bytes) -> Tuple[bytes, bytes, bytes]:
+    okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=transcript,
+               info=b"cometbft_tpu/secret_connection").derive(shared)
+    return okm[:32], okm[32:64], okm[64:96]
+
+
+class _Cipher:
+    """One direction: ChaCha20-Poly1305 with a little-endian counter
+    nonce (reference secret_connection.go incrNonce)."""
+
+    def __init__(self, key: bytes):
+        self._aead = ChaCha20Poly1305(key)
+        self._nonce = 0
+
+    def seal(self, plaintext: bytes) -> bytes:
+        n = self._nonce.to_bytes(12, "little")
+        self._nonce += 1
+        return self._aead.encrypt(n, plaintext, None)
+
+    def open(self, ciphertext: bytes) -> bytes:
+        n = self._nonce.to_bytes(12, "little")
+        self._nonce += 1
+        return self._aead.decrypt(n, ciphertext, None)
+
+
+class SecretConnection:
+    """Wraps a socket-like object (sendall/recv) after a mutual
+    authentication handshake."""
+
+    def __init__(self, sock, priv_key: Ed25519PrivKey):
+        self._sock = sock
+        self._recv_buf = b""
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes_raw()
+
+        # 1. ephemeral exchange
+        self._send_raw(eph_pub)
+        peer_eph = self._recv_exact(32)
+
+        # 2. key derivation; sort breaks the symmetry
+        lo, hi = sorted([eph_pub, peer_eph])
+        transcript = hashlib.sha256(b"eph:" + lo + hi).digest()
+        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(peer_eph))
+        k1, k2, challenge = _hkdf(shared, transcript)
+        if eph_pub == lo:
+            send_key, recv_key = k1, k2
+        else:
+            send_key, recv_key = k2, k1
+        self._send_cipher = _Cipher(send_key)
+        self._recv_cipher = _Cipher(recv_key)
+
+        # 3. identity auth over the encrypted channel
+        sig = priv_key.sign(challenge)
+        auth = (proto.f_bytes(1, priv_key.pub_key().bytes_())
+                + proto.f_bytes(2, sig))
+        self._write_frames(auth)
+        peer_auth = self._read_message()
+        f = proto.parse_fields(peer_auth)
+        peer_pub = proto.field_bytes(f, 1, b"")
+        peer_sig = proto.field_bytes(f, 2, b"")
+        if len(peer_pub) != 32 or not Ed25519PubKey(peer_pub). \
+                verify_signature(challenge, peer_sig):
+            raise HandshakeError("peer identity signature invalid")
+        self.peer_pubkey = Ed25519PubKey(peer_pub)
+
+    # --- framing --------------------------------------------------------------
+
+    def _send_raw(self, b: bytes) -> None:
+        self._sock.sendall(b)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._recv_buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed connection")
+            self._recv_buf += chunk
+        out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
+        return out
+
+    def _write_frames(self, data: bytes) -> None:
+        """Chunk + seal + length-prefix. Always writes >= 1 frame (an
+        empty message is a single empty chunk) and marks the final chunk
+        so message boundaries survive (u8 more-flag per frame)."""
+        chunks = [data[i:i + DATA_MAX_SIZE]
+                  for i in range(0, len(data), DATA_MAX_SIZE)] or [b""]
+        out = []
+        for i, c in enumerate(chunks):
+            more = 1 if i + 1 < len(chunks) else 0
+            sealed = self._send_cipher.seal(bytes([more]) + c)
+            out.append(struct.pack("<I", len(sealed)) + sealed)
+        self._sock.sendall(b"".join(out))
+
+    def _read_message(self) -> bytes:
+        parts = []
+        while True:
+            ln, = struct.unpack("<I", self._recv_exact(4))
+            if ln > DATA_MAX_SIZE + 17:
+                raise ConnectionError(f"oversized frame {ln}")
+            try:
+                plain = self._recv_cipher.open(self._recv_exact(ln))
+            except Exception as e:
+                raise ConnectionError(f"AEAD open failed: {e}") from e
+            parts.append(plain[1:])
+            if plain[0] == 0:
+                return b"".join(parts)
+
+    # --- public API -----------------------------------------------------------
+
+    def send_message(self, data: bytes) -> None:
+        self._write_frames(data)
+
+    def recv_message(self) -> bytes:
+        return self._read_message()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

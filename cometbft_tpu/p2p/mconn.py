@@ -1,0 +1,187 @@
+"""MConnection: priority-multiplexed logical channels over one secret
+connection (reference p2p/conn/connection.go:81-751).
+
+Shape preserved from the reference:
+- per-channel send queues with priorities; the send routine repeatedly
+  picks the channel with the least (recently-sent / priority) ratio
+  (connection.go:470 sendPacketMsg "least ratio" scheduling),
+- messages chunked into packets (channel id, eof flag, data) so a large
+  block part cannot starve votes (connection.go:740 maxPacketMsgSize),
+- ping/pong keepalive,
+- a recv routine reassembling packets per channel and dispatching
+  complete messages to the registered handler.
+
+This is also the pattern the verify-offload queue reuses host-side: the
+TPU flush queue is a prioritized channel like any other (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, Dict, List, Optional
+
+from ..types import proto
+
+MAX_PACKET_PAYLOAD = 1400          # connection.go defaultMaxPacketMsgPayloadSize
+PING_INTERVAL = 10.0
+_PKT_PING = 1
+_PKT_PONG = 2
+_PKT_MSG = 3
+
+
+@dataclass
+class ChannelDescriptor:
+    """reference p2p/conn/connection.go:729-741 ChannelDescriptor."""
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 100
+    recv_message_capacity: int = 22 * 1024 * 1024
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.queue: "queue.Queue[bytes]" = queue.Queue(
+            desc.send_queue_capacity)
+        self.sending: Optional[bytes] = None
+        self.sent_pos = 0
+        self.recently_sent = 0
+        self.recv_parts: List[bytes] = []
+        self.recv_size = 0
+
+    def next_packet(self) -> Optional[bytes]:
+        """Pop up to MAX_PACKET_PAYLOAD of the in-flight message."""
+        if self.sending is None:
+            try:
+                self.sending = self.queue.get_nowait()
+            except queue.Empty:
+                return None
+            self.sent_pos = 0
+        chunk = self.sending[self.sent_pos:self.sent_pos
+                             + MAX_PACKET_PAYLOAD]
+        self.sent_pos += len(chunk)
+        eof = self.sent_pos >= len(self.sending)
+        if eof:
+            self.sending = None
+        self.recently_sent += len(chunk) + 16
+        return (bytes([_PKT_MSG])
+                + proto.f_varint(1, self.desc.id)
+                + proto.f_varint(2, 1 if eof else 0)
+                + proto.f_bytes(3, chunk))
+
+    def has_data(self) -> bool:
+        return self.sending is not None or not self.queue.empty()
+
+
+class MConnection:
+    """reference p2p/conn/connection.go MConnection."""
+
+    def __init__(self, conn, descs: List[ChannelDescriptor],
+                 on_receive: Callable[[int, bytes], None],
+                 on_error: Optional[Callable[[Exception], None]] = None):
+        self._conn = conn
+        self._channels: Dict[int, _Channel] = {
+            d.id: _Channel(d) for d in descs}
+        self._on_receive = on_receive
+        self._on_error = on_error or (lambda e: None)
+        self._send_wake = threading.Event()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for fn, name in ((self._send_routine, "send"),
+                         (self._recv_routine, "recv")):
+            t = threading.Thread(target=fn, name=f"mconn-{name}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._send_wake.set()
+        self._conn.close()
+
+    def send(self, channel_id: int, msg: bytes, block: bool = True) -> bool:
+        """Queue a message (reference connection.go:380 Send /
+        TrySend with block=False)."""
+        ch = self._channels.get(channel_id)
+        if ch is None:
+            raise ValueError(f"unknown channel {channel_id:#x}")
+        try:
+            ch.queue.put(msg, block=block, timeout=10 if block else None)
+        except queue.Full:
+            return False
+        self._send_wake.set()
+        return True
+
+    # --- routines -------------------------------------------------------------
+
+    def _pick_channel(self) -> Optional[_Channel]:
+        """Least recently-sent/priority ratio (connection.go:470)."""
+        best, best_ratio = None, None
+        for ch in self._channels.values():
+            if not ch.has_data():
+                continue
+            ratio = ch.recently_sent / max(ch.desc.priority, 1)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    def _send_routine(self) -> None:
+        last_ping = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                ch = self._pick_channel()
+                if ch is None:
+                    if self._send_wake.wait(timeout=1.0):
+                        self._send_wake.clear()
+                    if time.monotonic() - last_ping > PING_INTERVAL:
+                        self._conn.send_message(bytes([_PKT_PING]))
+                        last_ping = time.monotonic()
+                    continue
+                pkt = ch.next_packet()
+                if pkt is not None:
+                    self._conn.send_message(pkt)
+                # decay so bursts don't permanently deprioritize
+                for c in self._channels.values():
+                    c.recently_sent = int(c.recently_sent * 0.8)
+        except (ConnectionError, OSError) as e:
+            if not self._stop.is_set():
+                self._on_error(e)
+
+    def _recv_routine(self) -> None:
+        try:
+            while not self._stop.is_set():
+                raw = self._conn.recv_message()
+                if not raw:
+                    continue
+                kind = raw[0]
+                if kind == _PKT_PING:
+                    self._conn.send_message(bytes([_PKT_PONG]))
+                    continue
+                if kind == _PKT_PONG:
+                    continue
+                if kind != _PKT_MSG:
+                    raise ConnectionError(f"unknown packet kind {kind}")
+                f = proto.parse_fields(raw[1:])
+                cid = proto.field_int(f, 1, 0)
+                eof = proto.field_int(f, 2, 0)
+                data = proto.field_bytes(f, 3, b"")
+                ch = self._channels.get(cid)
+                if ch is None:
+                    raise ConnectionError(f"peer sent unknown channel {cid}")
+                ch.recv_size += len(data)
+                if ch.recv_size > ch.desc.recv_message_capacity:
+                    raise ConnectionError("recv message exceeds capacity")
+                ch.recv_parts.append(data)
+                if eof:
+                    msg = b"".join(ch.recv_parts)
+                    ch.recv_parts, ch.recv_size = [], 0
+                    self._on_receive(cid, msg)
+        except (ConnectionError, OSError) as e:
+            if not self._stop.is_set():
+                self._on_error(e)

@@ -1,0 +1,162 @@
+"""Switch: peer lifecycle + reactor dispatch (reference p2p/switch.go:166,
+274, p2p/base_reactor.go, p2p/peer.go).
+
+Reactors register channel descriptors; the switch owns peers (each an
+MConnection over a SecretConnection) and routes inbound messages to the
+reactor that claimed the channel. Broadcast fans a message to every
+connected peer's channel queue.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Protocol
+
+from ..crypto.keys import Ed25519PrivKey
+from .conn import SecretConnection
+from .mconn import ChannelDescriptor, MConnection
+from .transport import NodeInfo, Transport, node_info_for
+
+
+class Reactor(Protocol):
+    """reference p2p/base_reactor.go Reactor."""
+
+    def get_channels(self) -> List[ChannelDescriptor]: ...
+    def add_peer(self, peer: "Peer") -> None: ...
+    def remove_peer(self, peer: "Peer", reason: str) -> None: ...
+    def receive(self, channel_id: int, peer: "Peer", msg: bytes) -> None: ...
+
+
+class Peer:
+    """reference p2p/peer.go peer."""
+
+    def __init__(self, switch: "Switch", sc: SecretConnection,
+                 info: NodeInfo, outbound: bool):
+        self.switch = switch
+        self.node_info = info
+        self.id = info.node_id
+        self.outbound = outbound
+        self._mconn = MConnection(
+            sc, switch.channel_descriptors(),
+            on_receive=lambda cid, msg: switch._dispatch(self, cid, msg),
+            on_error=lambda e: switch.stop_peer(self, f"conn error: {e}"))
+
+    def start(self) -> None:
+        self._mconn.start()
+
+    def stop(self) -> None:
+        self._mconn.stop()
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        return self._mconn.send(channel_id, msg, block=True)
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        return self._mconn.send(channel_id, msg, block=False)
+
+    def __repr__(self) -> str:
+        return f"Peer{{{self.id[:12]} {'out' if self.outbound else 'in'}}}"
+
+
+class Switch:
+    """reference p2p/switch.go Switch."""
+
+    def __init__(self, priv_key: Ed25519PrivKey, network: str,
+                 moniker: str = "node"):
+        self.priv_key = priv_key
+        self.network = network
+        self._reactors: List[Reactor] = []
+        self._chan_to_reactor: Dict[int, Reactor] = {}
+        self._peers: Dict[str, Peer] = {}
+        self._lock = threading.RLock()
+        self._moniker = moniker
+        self.transport: Optional[Transport] = None
+        self.banned: set = set()
+
+    # --- setup ----------------------------------------------------------------
+
+    def add_reactor(self, reactor: Reactor) -> None:
+        for d in reactor.get_channels():
+            if d.id in self._chan_to_reactor:
+                raise ValueError(f"channel {d.id:#x} already claimed")
+            self._chan_to_reactor[d.id] = reactor
+        self._reactors.append(reactor)
+
+    def channel_descriptors(self) -> List[ChannelDescriptor]:
+        return [d for r in self._reactors for d in r.get_channels()]
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0):
+        channels = bytes(self._chan_to_reactor.keys())
+        self.transport = Transport(
+            self.priv_key,
+            node_info_for(self.priv_key, self.network, channels,
+                          self._moniker))
+        addr = self.transport.listen(host, port)
+        self.transport.accept_loop(self._on_connection)
+        return addr
+
+    def dial(self, host: str, port: int) -> None:
+        """reference switch.go DialPeerWithAddress."""
+        if self.transport is None:
+            self.listen()
+        self.transport.dial(host, port, self._on_connection)
+
+    # --- peer lifecycle -------------------------------------------------------
+
+    def _on_connection(self, sc: SecretConnection, info: NodeInfo,
+                       outbound: bool) -> None:
+        with self._lock:
+            if info.node_id in self.banned:
+                sc.close()
+                return
+            if info.node_id == self.transport.node_id:
+                sc.close()  # self-connection
+                return
+            if info.node_id in self._peers:
+                sc.close()  # duplicate
+                return
+            peer = Peer(self, sc, info, outbound)
+            self._peers[info.node_id] = peer
+        peer.start()
+        for r in self._reactors:
+            r.add_peer(peer)
+
+    def stop_peer(self, peer: Peer, reason: str,
+                  ban: bool = False) -> None:
+        """reference switch.go StopPeerForError."""
+        with self._lock:
+            if self._peers.get(peer.id) is not peer:
+                return
+            del self._peers[peer.id]
+            if ban:
+                self.banned.add(peer.id)
+        peer.stop()
+        for r in self._reactors:
+            r.remove_peer(peer, reason)
+
+    def peers(self) -> List[Peer]:
+        with self._lock:
+            return list(self._peers.values())
+
+    def broadcast(self, channel_id: int, msg: bytes) -> None:
+        """reference switch.go:274 Broadcast (non-blocking per peer)."""
+        for peer in self.peers():
+            peer.try_send(channel_id, msg)
+
+    # --- dispatch -------------------------------------------------------------
+
+    def _dispatch(self, peer: Peer, channel_id: int, msg: bytes) -> None:
+        reactor = self._chan_to_reactor.get(channel_id)
+        if reactor is None:
+            self.stop_peer(peer, f"unclaimed channel {channel_id:#x}")
+            return
+        try:
+            reactor.receive(channel_id, peer, msg)
+        except Exception as e:  # noqa: BLE001 — a peer's bad message
+            # must not kill the recv routine; drop the peer instead
+            self.stop_peer(peer, f"reactor error: {e}", ban=True)
+
+    def stop(self) -> None:
+        if self.transport is not None:
+            self.transport.close()
+        for peer in self.peers():
+            self.stop_peer(peer, "switch stopping")

@@ -1,0 +1,186 @@
+"""P2P stack: SecretConnection crypto properties, MConnection
+multiplexing/priorities, Switch handshake + dispatch over real localhost
+TCP sockets (reference p2p/conn/secret_connection_test.go,
+connection_test.go, switch_test.go)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.p2p.conn import SecretConnection, HandshakeError
+from cometbft_tpu.p2p.mconn import ChannelDescriptor, MConnection
+from cometbft_tpu.p2p.switch import Switch
+
+
+def _sock_pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def _secret_pair(key_a=None, key_b=None):
+    ka = key_a or Ed25519PrivKey.generate()
+    kb = key_b or Ed25519PrivKey.generate()
+    sa, sb = _sock_pair()
+    out = {}
+
+    def side(name, sock, key):
+        out[name] = SecretConnection(sock, key)
+
+    ta = threading.Thread(target=side, args=("a", sa, ka))
+    tb = threading.Thread(target=side, args=("b", sb, kb))
+    ta.start(); tb.start(); ta.join(5); tb.join(5)
+    assert "a" in out and "b" in out, "handshake did not complete"
+    return out["a"], out["b"], ka, kb
+
+
+def test_secret_connection_roundtrip_and_identity():
+    ca, cb, ka, kb = _secret_pair()
+    # identities learned across the channel match the real keys
+    assert ca.peer_pubkey.bytes_() == kb.pub_key().bytes_()
+    assert cb.peer_pubkey.bytes_() == ka.pub_key().bytes_()
+    # bidirectional messages, incl. empty and > frame-size
+    big = bytes(range(256)) * 20  # 5120 B > 1024 chunk
+    ca.send_message(b"hello")
+    cb.send_message(big)
+    ca.send_message(b"")
+    assert cb.recv_message() == b"hello"
+    assert ca.recv_message() == big
+    assert cb.recv_message() == b""
+
+
+def test_secret_connection_ciphertext_not_plaintext():
+    """Bytes on the wire never contain the plaintext (it's AEAD-sealed)."""
+    captured = []
+
+    class TapSock:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def sendall(self, b):
+            captured.append(bytes(b))
+            self.inner.sendall(b)
+
+        def recv(self, n):
+            return self.inner.recv(n)
+
+        def close(self):
+            self.inner.close()
+
+    ka, kb = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+    sa, sb = _sock_pair()
+    out = {}
+    ta = threading.Thread(
+        target=lambda: out.setdefault("a", SecretConnection(TapSock(sa), ka)))
+    tb = threading.Thread(
+        target=lambda: out.setdefault("b", SecretConnection(sb, kb)))
+    ta.start(); tb.start(); ta.join(5); tb.join(5)
+    secret = b"the secret consensus vote payload"
+    out["a"].send_message(secret)
+    assert out["b"].recv_message() == secret
+    assert not any(secret in blob for blob in captured)
+
+
+def test_secret_connection_tamper_detected():
+    ca, cb, *_ = _secret_pair()
+
+    # flip a ciphertext bit in transit by wrapping the raw socket
+    raw = ca._sock
+    ca.send_message(b"payload-one")
+    assert cb.recv_message() == b"payload-one"
+    # craft a corrupted frame directly
+    import struct
+    sealed = ca._send_cipher.seal(b"\x00corrupt-me")
+    sealed = sealed[:-1] + bytes([sealed[-1] ^ 1])
+    raw.sendall(struct.pack("<I", len(sealed)) + sealed)
+    with pytest.raises(ConnectionError):
+        cb.recv_message()
+
+
+def test_mconnection_multiplex_and_reassembly():
+    ca, cb, *_ = _secret_pair()
+    got = []
+    done = threading.Event()
+
+    def on_recv(cid, msg):
+        got.append((cid, msg))
+        if len(got) == 3:
+            done.set()
+
+    descs = [ChannelDescriptor(id=0x20, priority=5),
+             ChannelDescriptor(id=0x21, priority=1)]
+    ma = MConnection(ca, descs, on_receive=lambda c, m: None)
+    mb = MConnection(cb, descs, on_receive=on_recv)
+    ma.start(); mb.start()
+    big = b"B" * 5000  # forces multi-packet reassembly
+    ma.send(0x20, b"votes")
+    ma.send(0x21, big)
+    ma.send(0x20, b"more-votes")
+    assert done.wait(10), f"only received {got}"
+    by_chan = {}
+    for cid, m in got:
+        by_chan.setdefault(cid, []).append(m)
+    assert by_chan[0x20] == [b"votes", b"more-votes"]
+    assert by_chan[0x21] == [big]
+    ma.stop(); mb.stop()
+
+
+class EchoReactor:
+    """Echoes every message back on the same channel."""
+
+    def __init__(self, cid=0x42):
+        self.cid = cid
+        self.received = []
+        self.peers = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.cid, priority=1)]
+
+    def add_peer(self, peer):
+        self.peers.append(peer)
+
+    def remove_peer(self, peer, reason):
+        pass
+
+    def receive(self, channel_id, peer, msg):
+        self.received.append(msg)
+        if not msg.startswith(b"echo:"):
+            peer.send(channel_id, b"echo:" + msg)
+
+
+def test_switch_tcp_handshake_and_echo():
+    """Two switches over real localhost TCP: authenticated handshake,
+    channel negotiation, reactor round-trip."""
+    ka, kb = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+    sa, sb = Switch(ka, "net-1", "alice"), Switch(kb, "net-1", "bob")
+    ra, rb = EchoReactor(), EchoReactor()
+    sa.add_reactor(ra); sb.add_reactor(rb)
+    host, port = sa.listen()
+    sb.dial(host, port)
+    deadline = time.monotonic() + 10
+    while (not sa.peers() or not sb.peers()) and \
+            time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert sa.peers() and sb.peers(), "peers never connected"
+    assert sa.peers()[0].id == kb.pub_key().address().hex()
+    assert sb.peers()[0].id == ka.pub_key().address().hex()
+
+    sb.peers()[0].send(0x42, b"ping-message")
+    deadline = time.monotonic() + 10
+    while not any(m == b"echo:ping-message" for m in rb.received):
+        assert time.monotonic() < deadline, (ra.received, rb.received)
+        time.sleep(0.02)
+    sa.stop(); sb.stop()
+
+
+def test_switch_rejects_wrong_network():
+    ka, kb = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+    sa, sb = Switch(ka, "net-1"), Switch(kb, "net-OTHER")
+    sa.add_reactor(EchoReactor()); sb.add_reactor(EchoReactor())
+    host, port = sa.listen()
+    sb.dial(host, port)
+    time.sleep(0.5)
+    assert not sa.peers() and not sb.peers()
+    sa.stop(); sb.stop()

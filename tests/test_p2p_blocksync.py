@@ -1,0 +1,111 @@
+"""End-to-end p2p blocksync: a fresh node catches up from a serving node
+over real localhost TCP with authenticated encryption and pipelined
+per-height requesters (VERDICT r3 item 6; reference
+internal/blocksync/reactor.go + pool.go over p2p/conn).
+
+Uses the same (4 validators, batch 64) kernel bucket as test_blocksync so
+the compile cache is shared.
+"""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.db.kv import MemDB
+from cometbft_tpu.engine.blocksync import BlocksyncReactor
+from cometbft_tpu.engine.chain_gen import generate_chain
+from cometbft_tpu.engine.pool import BlockPool, PooledSource
+from cometbft_tpu.engine.reactor import BlocksyncNetReactor, NetSource
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State, StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+
+CHAIN = generate_chain(n_blocks=12, n_validators=4, txs_per_block=2,
+                       chain_id="tpu-chain")
+
+
+def _serving_node():
+    """A node whose BlockStore holds the full generated chain."""
+    store = BlockStore(MemDB())
+    for i, blk in enumerate(CHAIN.blocks):
+        store.save_block(blk, blk.make_part_set(), CHAIN.seen_commits[i])
+    sw = Switch(Ed25519PrivKey.generate(), CHAIN.chain_id, "server")
+    reactor = BlocksyncNetReactor(store)
+    sw.add_reactor(reactor)
+    return sw, store
+
+
+def _syncing_node():
+    app = KVStoreApplication()
+    app.init_chain(CHAIN.chain_id, 1, [], b"")
+    store = BlockStore(MemDB())
+    executor = BlockExecutor(app, state_store=StateStore(MemDB()),
+                             block_store=store)
+    sw = Switch(Ed25519PrivKey.generate(), CHAIN.chain_id, "syncer")
+    reactor = BlocksyncNetReactor(store)
+    sw.add_reactor(reactor)
+    return sw, store, executor, reactor
+
+
+def test_tcp_blocksync_catchup():
+    server_sw, _server_store = _serving_node()
+    sync_sw, sync_store, executor, net_reactor = _syncing_node()
+    try:
+        host, port = server_sw.listen()
+        sync_sw.dial(host, port)
+        deadline = time.monotonic() + 10
+        while not sync_sw.peers() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sync_sw.peers(), "dial failed"
+
+        src = NetSource(net_reactor, sync_sw)
+        assert src.max_height() == CHAIN.max_height()
+        pooled = PooledSource(src, start_height=1, lookahead=8,
+                              n_workers=4)
+        engine = BlocksyncReactor(executor, sync_store, pooled,
+                                  CHAIN.chain_id, tile_size=5,
+                                  batch_size=64)
+        state = State.from_genesis(CHAIN.genesis)
+        state = engine.sync(state, CHAIN.max_height())
+        assert state.last_block_height == CHAIN.max_height()
+        # synced blocks byte-identical to the source chain
+        for h in range(1, CHAIN.max_height() + 1):
+            assert sync_store.load_block(h).hash() == \
+                CHAIN.blocks[h - 1].hash()
+        assert engine.stats.tiles_flushed >= 2
+        pooled.stop()
+    finally:
+        server_sw.stop()
+        sync_sw.stop()
+
+
+def test_block_pool_pipelines_and_retries():
+    """The pool prefetches ahead of consumption and refetches after
+    invalidate (the bpRequester redo path)."""
+    calls = []
+
+    class SlowSource:
+        def max_height(self):
+            return 20
+
+        def fetch(self, h):
+            calls.append(h)
+            time.sleep(0.01)
+            return ("blk%d" % h, None)
+
+        def ban(self, h):
+            pass
+
+    pool = BlockPool(SlowSource().fetch, lambda: 20, start_height=1,
+                     lookahead=10, n_workers=4)
+    got = pool.pop(1, timeout=5)
+    assert got[0] == "blk1"
+    time.sleep(0.3)  # prefetchers drain the lookahead window
+    assert len(set(calls)) >= 10, "no pipelining happened"
+    pool.invalidate(3)
+    assert pool.pop(3, timeout=5)[0] == "blk3"
+    assert calls.count(3) >= 2, "invalidate must refetch"
+    pool.stop()
