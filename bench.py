@@ -16,9 +16,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
+from cometbft_tpu.libs.jax_cache import enable_compile_cache  # noqa: E402
 
 BASELINE_SIGS_PER_SEC = 78_000.0  # CPU curve25519-voi, 1024-sig batches
 
@@ -62,6 +61,7 @@ def _gen_signatures(n, n_validators=200, msg_len=122, seed=7):
 def main():
     import numpy as np
     import jax
+    enable_compile_cache()
     from cometbft_tpu.ops.ed25519 import (
         verify_rlc_kernel, prepare_batch, make_rlc_coefficients)
 

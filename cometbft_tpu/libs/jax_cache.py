@@ -1,0 +1,23 @@
+"""Shared jax persistent compile-cache setup.
+
+jax is pre-imported by the ambient environment (sitecustomize), so env
+vars like JAX_COMPILATION_CACHE_DIR are latched before any entry point
+runs — configuration MUST go through jax.config. Every entry point
+(tests, bench, graft entry, tools) calls this one helper so the cache
+location and threshold stay consistent.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    import jax
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        cache_dir or os.path.join(_REPO_ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

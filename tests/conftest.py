@@ -14,15 +14,16 @@ jax.config, not os.environ.
 import os
 import sys
 
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-
 import jax
 
+# jax is pre-imported by the ambient environment (sitecustomize), so env
+# vars are latched before this file runs — ALL config must go through
+# jax.config, including the persistent compile cache (without it every
+# test run recompiles the kernels from scratch, minutes per variant).
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from cometbft_tpu.libs.jax_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
