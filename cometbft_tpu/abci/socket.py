@@ -1,0 +1,269 @@
+"""ABCI over a socket: server hosting an Application out-of-process and
+the matching client (reference abci/server/socket_server.go,
+abci/client/socket_client.go, internal/protoio length-delimited framing).
+
+Framing: uvarint message length || payload. Payload: u8 method id ||
+JSON body (the node-local serialization — this framework's two sides are
+both in-tree; the reference's gogoproto Request/Response envelope plays
+the same role). Requests are processed strictly in order per connection,
+matching the reference's ordered-response contract
+(socket_client.go didn't multiplex either).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Optional, Tuple
+
+from ..types import proto
+from .application import (Application, CheckTxResult, ExecTxResult,
+                          RequestFinalizeBlock, ResponseCommit,
+                          ResponseFinalizeBlock, ResponseInfo,
+                          ValidatorUpdate)
+from ..types.proto import Timestamp
+
+_M_ECHO = 0
+_M_INFO = 1
+_M_CHECK_TX = 2
+_M_PREPARE = 3
+_M_PROCESS = 4
+_M_FINALIZE = 5
+_M_COMMIT = 6
+_M_QUERY = 7
+_M_INIT_CHAIN = 8
+_M_FLUSH = 9
+
+
+def _send_msg(sock, method: int, body: dict) -> None:
+    payload = bytes([method]) + json.dumps(body).encode()
+    sock.sendall(proto.uvarint(len(payload)) + payload)
+
+
+class _Reader:
+    def __init__(self, sock):
+        self._sock = sock
+        self._buf = b""
+
+    def read_msg(self) -> Tuple[int, dict]:
+        ln, used = self._read_uvarint()
+        while len(self._buf) < used + ln:
+            self._fill()
+        payload = self._buf[used:used + ln]
+        self._buf = self._buf[used + ln:]
+        return payload[0], json.loads(payload[1:] or b"{}")
+
+    def _read_uvarint(self):
+        while True:
+            try:
+                return proto.read_uvarint(self._buf, 0)
+            except (ValueError, IndexError):
+                self._fill()
+
+    def _fill(self):
+        chunk = self._sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("ABCI peer closed")
+        self._buf += chunk
+
+
+def _hx(b: bytes) -> str:
+    return b.hex()
+
+
+def _unhx(s: str) -> bytes:
+    return bytes.fromhex(s)
+
+
+class ABCIServer:
+    """Hosts an Application for remote consensus engines (reference
+    abci/server/socket_server.go)."""
+
+    def __init__(self, app: Application, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.app = app
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(8)
+        self.addr = self._listener.getsockname()
+        self._stop = threading.Event()
+        # one lock across connections: the app sees a serialized request
+        # stream even with 4 named connections (the reference's apps
+        # rely on the same global ordering)
+        self._app_lock = threading.Lock()
+
+    def start(self) -> None:
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._listener.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
+        threading.Thread(target=accept_loop, name="abci-accept",
+                         daemon=True).start()
+
+    def _serve_conn(self, conn) -> None:
+        reader = _Reader(conn)
+        try:
+            while not self._stop.is_set():
+                method, body = reader.read_msg()
+                with self._app_lock:
+                    resp = self._handle(method, body)
+                _send_msg(conn, method, resp)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, method: int, b: dict) -> dict:
+        app = self.app
+        if method in (_M_ECHO, _M_FLUSH):
+            return b
+        if method == _M_INFO:
+            r = app.info()
+            return {"data": r.data, "version": r.version,
+                    "app_version": r.app_version,
+                    "last_block_height": r.last_block_height,
+                    "last_block_app_hash": _hx(r.last_block_app_hash)}
+        if method == _M_CHECK_TX:
+            r = app.check_tx(_unhx(b["tx"]))
+            return {"code": r.code, "gas_wanted": r.gas_wanted,
+                    "log": r.log}
+        if method == _M_PREPARE:
+            txs = app.prepare_proposal([_unhx(t) for t in b["txs"]],
+                                       b["max_tx_bytes"])
+            return {"txs": [_hx(t) for t in txs]}
+        if method == _M_PROCESS:
+            ok = app.process_proposal([_unhx(t) for t in b["txs"]],
+                                      b["height"])
+            return {"accept": bool(ok)}
+        if method == _M_INIT_CHAIN:
+            vals = [ValidatorUpdate(v["type"], _unhx(v["pub_key"]),
+                                    v["power"])
+                    for v in b.get("validators", [])]
+            updates, app_hash = app.init_chain(
+                b["chain_id"], b["initial_height"], vals,
+                _unhx(b["app_state"]))
+            return {"app_hash": _hx(app_hash),
+                    "updates": [{"type": u.pub_key_type,
+                                 "pub_key": _hx(u.pub_key_bytes),
+                                 "power": u.power} for u in updates]}
+        if method == _M_FINALIZE:
+            req = RequestFinalizeBlock(
+                txs=[_unhx(t) for t in b["txs"]],
+                height=b["height"],
+                time=Timestamp(b["time_s"], b["time_ns"]),
+                proposer_address=_unhx(b["proposer"]),
+                hash=_unhx(b["hash"]),
+                next_validators_hash=_unhx(b["next_vals"]))
+            r = app.finalize_block(req)
+            return json.loads(r.encode())
+        if method == _M_COMMIT:
+            r = app.commit()
+            return {"retain_height": r.retain_height}
+        if method == _M_QUERY:
+            code, value = app.query(b["path"], _unhx(b["data"]))
+            return {"code": code, "value": _hx(value)}
+        raise ValueError(f"unknown ABCI method {method}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class SocketClient:
+    """Application-shaped proxy over a socket (reference
+    abci/client/socket_client.go) — consumers (BlockExecutor, mempool,
+    proxy) cannot tell it from an in-process app."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self._reader = _Reader(self._sock)
+        self._lock = threading.Lock()
+
+    def _call(self, method: int, body: dict) -> dict:
+        with self._lock:
+            _send_msg(self._sock, method, body)
+            got_method, resp = self._reader.read_msg()
+            if got_method != method:
+                raise ConnectionError(
+                    f"out-of-order ABCI response {got_method} != {method}")
+            return resp
+
+    # --- Application interface ------------------------------------------------
+
+    def echo(self, msg: str) -> str:
+        return self._call(_M_ECHO, {"msg": msg})["msg"]
+
+    def info(self) -> ResponseInfo:
+        r = self._call(_M_INFO, {})
+        return ResponseInfo(r["data"], r["version"], r["app_version"],
+                            r["last_block_height"],
+                            _unhx(r["last_block_app_hash"]))
+
+    def check_tx(self, tx: bytes) -> CheckTxResult:
+        r = self._call(_M_CHECK_TX, {"tx": _hx(tx)})
+        return CheckTxResult(code=r["code"], gas_wanted=r["gas_wanted"],
+                             log=r["log"])
+
+    def init_chain(self, chain_id, initial_height, validators,
+                   app_state_bytes):
+        vals = []
+        for v in validators or []:
+            if hasattr(v, "pub_key_bytes"):       # ValidatorUpdate
+                vals.append({"type": v.pub_key_type,
+                             "pub_key": _hx(v.pub_key_bytes),
+                             "power": v.power})
+            else:                                  # types.Validator
+                vals.append({"type": v.pub_key.type_(),
+                             "pub_key": _hx(v.pub_key.bytes_()),
+                             "power": v.voting_power})
+        r = self._call(_M_INIT_CHAIN, {
+            "chain_id": chain_id, "initial_height": initial_height,
+            "validators": vals, "app_state": _hx(app_state_bytes)})
+        updates = [ValidatorUpdate(u["type"], _unhx(u["pub_key"]),
+                                   u["power"]) for u in r["updates"]]
+        return updates, _unhx(r["app_hash"])
+
+    def prepare_proposal(self, txs, max_tx_bytes):
+        r = self._call(_M_PREPARE, {"txs": [_hx(t) for t in txs],
+                                    "max_tx_bytes": max_tx_bytes})
+        return [_unhx(t) for t in r["txs"]]
+
+    def process_proposal(self, txs, height) -> bool:
+        return self._call(_M_PROCESS, {"txs": [_hx(t) for t in txs],
+                                       "height": height})["accept"]
+
+    def finalize_block(self, req: RequestFinalizeBlock
+                       ) -> ResponseFinalizeBlock:
+        r = self._call(_M_FINALIZE, {
+            "txs": [_hx(t) for t in req.txs], "height": req.height,
+            "time_s": req.time.seconds, "time_ns": req.time.nanos,
+            "proposer": _hx(req.proposer_address), "hash": _hx(req.hash),
+            "next_vals": _hx(req.next_validators_hash)})
+        return ResponseFinalizeBlock.decode(json.dumps(r).encode())
+
+    def commit(self) -> ResponseCommit:
+        return ResponseCommit(
+            self._call(_M_COMMIT, {})["retain_height"])
+
+    def query(self, path: str, data: bytes):
+        r = self._call(_M_QUERY, {"path": path, "data": _hx(data)})
+        return r["code"], _unhx(r["value"])
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

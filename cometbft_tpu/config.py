@@ -1,0 +1,147 @@
+"""Node configuration (reference config/config.go:78-93 — the master
+Config of sections — and config/toml.go's file round-trip).
+
+TOML read uses the stdlib tomllib; writing emits the subset grammar we
+read back (flat sections of scalars).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass, field as dc_field
+from typing import Optional
+
+
+@dataclass
+class BaseConfig:
+    """reference config/config.go BaseConfig."""
+    chain_id: str = "tpu-chain"
+    moniker: str = "tpu-node"
+    db_backend: str = "filedb"          # memdb | filedb | native
+    db_dir: str = "data"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_file: str = "config/priv_validator.json"
+    node_key_file: str = "config/node_key.json"
+    block_sync: bool = True
+
+
+@dataclass
+class P2PConfig:
+    """reference config/config.go P2PConfig."""
+    laddr: str = "127.0.0.1:0"
+    persistent_peers: str = ""          # comma-separated host:port
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "127.0.0.1:0"
+    enable: bool = True
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000
+    cache_size: int = 10000
+    max_tx_bytes: int = 1024 * 1024
+    max_txs_bytes: int = 64 * 1024 * 1024
+    recheck: bool = True
+
+
+@dataclass
+class ConsensusTimeoutsConfig:
+    timeout_propose: int = 3000
+    timeout_propose_delta: int = 500
+    timeout_prevote: int = 1000
+    timeout_prevote_delta: int = 500
+    timeout_precommit: int = 1000
+    timeout_precommit_delta: int = 500
+    timeout_commit: int = 1000
+    create_empty_blocks: bool = True
+    wal_file: str = "data/cs.wal"
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_laddr: str = ""
+
+
+@dataclass
+class Config:
+    """reference config/config.go Config."""
+    base: BaseConfig = dc_field(default_factory=BaseConfig)
+    p2p: P2PConfig = dc_field(default_factory=P2PConfig)
+    rpc: RPCConfig = dc_field(default_factory=RPCConfig)
+    mempool: MempoolConfig = dc_field(default_factory=MempoolConfig)
+    consensus: ConsensusTimeoutsConfig = dc_field(
+        default_factory=ConsensusTimeoutsConfig)
+    instrumentation: InstrumentationConfig = dc_field(
+        default_factory=InstrumentationConfig)
+    root_dir: str = "."
+
+    def validate_basic(self) -> None:
+        if not self.base.chain_id:
+            raise ValueError("chain_id must be set")
+        if self.base.db_backend not in ("memdb", "filedb", "native"):
+            raise ValueError(f"unknown db backend {self.base.db_backend}")
+        for name in ("timeout_propose", "timeout_prevote",
+                     "timeout_precommit", "timeout_commit"):
+            if getattr(self.consensus, name) < 0:
+                raise ValueError(f"negative {name}")
+
+    def path(self, rel: str) -> str:
+        return os.path.join(self.root_dir, rel)
+
+    # --- TOML round-trip ------------------------------------------------------
+
+    def to_toml(self) -> str:
+        import json as _json
+
+        def emit(section: str, obj) -> str:
+            lines = [f"[{section}]"]
+            for k, v in asdict(obj).items():
+                if isinstance(v, bool):
+                    lines.append(f"{k} = {'true' if v else 'false'}")
+                elif isinstance(v, int):
+                    lines.append(f"{k} = {v}")
+                else:
+                    # JSON string escaping is valid TOML basic-string
+                    # escaping (quotes, backslashes)
+                    lines.append(f"{k} = {_json.dumps(str(v))}")
+            return "\n".join(lines)
+        return "\n\n".join([
+            emit("base", self.base), emit("p2p", self.p2p),
+            emit("rpc", self.rpc), emit("mempool", self.mempool),
+            emit("consensus", self.consensus),
+            emit("instrumentation", self.instrumentation)]) + "\n"
+
+    @classmethod
+    def from_toml(cls, text: str, root_dir: str = ".") -> "Config":
+        import tomllib
+        d = tomllib.loads(text)
+        cfg = cls(root_dir=root_dir)
+        for section, target in (("base", cfg.base), ("p2p", cfg.p2p),
+                                ("rpc", cfg.rpc),
+                                ("mempool", cfg.mempool),
+                                ("consensus", cfg.consensus),
+                                ("instrumentation", cfg.instrumentation)):
+            for k, v in d.get(section, {}).items():
+                if hasattr(target, k):
+                    setattr(target, k, v)
+        cfg.validate_basic()
+        return cfg
+
+    def write(self, path: Optional[str] = None) -> str:
+        path = path or self.path("config/config.toml")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_toml())
+        return path
+
+    @classmethod
+    def load(cls, root_dir: str) -> "Config":
+        path = os.path.join(root_dir, "config/config.toml")
+        with open(path) as f:
+            return cls.from_toml(f.read(), root_dir)

@@ -1,0 +1,3 @@
+from .kv import TxIndexer, BlockIndexer, IndexerService
+
+__all__ = ["TxIndexer", "BlockIndexer", "IndexerService"]

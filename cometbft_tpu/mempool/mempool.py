@@ -137,11 +137,12 @@ class CListMempool:
             self._txs[key] = _MempoolTx(tx, self._height, gas)
             self._bytes += len(tx)
             for cb in self._notify:
-                cb()
+                cb(tx)
             return CODE_TYPE_OK
 
-    def on_new_tx(self, cb: Callable[[], None]) -> None:
-        """Subscribe to tx arrival (consensus timeout wake-up / gossip)."""
+    def on_new_tx(self, cb: Callable[[bytes], None]) -> None:
+        """Subscribe to tx arrival with the admitted tx (gossip relay /
+        consensus wake-up)."""
         self._notify.append(cb)
 
     # --- reaping -------------------------------------------------------------

@@ -1,0 +1,3 @@
+from .node import Node, load_genesis, save_genesis
+
+__all__ = ["Node", "load_genesis", "save_genesis"]

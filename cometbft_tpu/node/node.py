@@ -1,0 +1,226 @@
+"""Node assembly: the dependency-injection graph wiring every subsystem
+(reference node/node.go:273-536 NewNode, :539-609 OnStart).
+
+Boot order follows the reference: DBs → state (store or genesis) →
+proxy app conns → ABCI handshake/replay → event bus + indexers →
+mempool/evidence → consensus (+WAL) → reactors → switch → RPC.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import List, Optional
+
+from ..abci.application import Application, RequestFinalizeBlock
+from ..config import Config
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.state import ConsensusConfig, ConsensusState
+from ..consensus.wal import WAL
+from ..crypto.keys import Ed25519PrivKey, Ed25519PubKey
+from ..db.kv import open_db
+from ..engine.reactor import BlocksyncNetReactor, NetSource
+from ..evidence.pool import EvidencePool
+from ..indexer.kv import BlockIndexer, IndexerService, TxIndexer
+from ..mempool.mempool import CListMempool
+from ..p2p.switch import Switch
+from ..privval.file import FilePV
+from ..proxy.multi_app_conn import AppConns, local_client_creator
+from ..pubsub.events import EventBus
+from ..rpc.server import RPCEnvironment, RPCServer
+from ..state.execution import BlockExecutor
+from ..state.state import GenesisDoc, State, StateStore
+from ..state.state import ConsensusParams
+from ..store.blockstore import BlockStore
+from ..types.block import BlockID
+from ..types.proto import Timestamp
+from ..types.validator import Validator
+
+
+def save_genesis(gen: GenesisDoc, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({
+            "chain_id": gen.chain_id,
+            "initial_height": gen.initial_height,
+            "genesis_time": [gen.genesis_time.seconds,
+                             gen.genesis_time.nanos],
+            "validators": [{"pub_key": v.pub_key.bytes_().hex(),
+                            "power": v.voting_power}
+                           for v in gen.validators],
+            "app_state": gen.app_state.hex(),
+            "app_hash": gen.app_hash.hex(),
+        }, f, indent=1)
+
+
+def load_genesis(path: str) -> GenesisDoc:
+    with open(path) as f:
+        d = json.load(f)
+    return GenesisDoc(
+        chain_id=d["chain_id"],
+        initial_height=d.get("initial_height", 1),
+        genesis_time=Timestamp(*d.get("genesis_time", [0, 0])),
+        validators=[Validator(Ed25519PubKey(bytes.fromhex(v["pub_key"])),
+                              v["power"]) for v in d["validators"]],
+        app_state=bytes.fromhex(d.get("app_state", "")),
+        app_hash=bytes.fromhex(d.get("app_hash", "")))
+
+
+class Node:
+    """reference node/node.go Node."""
+
+    def __init__(self, config: Config, app: Application,
+                 genesis: Optional[GenesisDoc] = None,
+                 priv_validator: Optional[FilePV] = None,
+                 node_key: Optional[Ed25519PrivKey] = None):
+        config.validate_basic()
+        self.config = config
+        self.genesis = genesis or load_genesis(
+            config.path(config.base.genesis_file))
+
+        # --- DBs (node.go:284 initDBs) ---------------------------------------
+        be, ddir = config.base.db_backend, config.path(config.base.db_dir)
+        self.block_store = BlockStore(open_db(be, "blockstore", ddir))
+        self.state_store = StateStore(open_db(be, "state", ddir))
+        self._indexer_db = open_db(be, "indexer", ddir)
+
+        # --- state: stored or genesis (node.go:289) --------------------------
+        state = self.state_store.load()
+        if state is None:
+            state = State.from_genesis(self.genesis)
+            # bootstrap-save so the genesis validator set is indexed at
+            # the initial height (reference state/store.go Bootstrap)
+            self.state_store.save(state)
+
+        # --- proxy app (node.go:319) -----------------------------------------
+        self.app_conns = AppConns(local_client_creator(app))
+        self._handshake(state)
+
+        # --- event bus + indexers (node.go:328-334) --------------------------
+        self.event_bus = EventBus()
+        self.tx_indexer = TxIndexer(self._indexer_db)
+        self.block_indexer = BlockIndexer(self._indexer_db)
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.block_indexer, self.event_bus)
+
+        # --- privval (node.go:343) -------------------------------------------
+        if priv_validator is None:
+            pv_path = config.path(config.base.priv_validator_file)
+            priv_validator = FilePV.load_or_generate(pv_path)
+        self.priv_validator = priv_validator
+
+        # --- mempool + evidence (node.go:385-409) ----------------------------
+        mc = config.mempool
+        self.mempool = CListMempool(
+            lambda tx: (self.app_conns.mempool.check_tx(tx).code, 0),
+            max_tx_bytes=mc.max_tx_bytes, max_txs_bytes=mc.max_txs_bytes,
+            size=mc.size, cache_size=mc.cache_size, recheck=mc.recheck)
+        self.evidence_pool = EvidencePool(
+            state_store=self.state_store, block_store=self.block_store)
+
+        # --- executor + consensus (node.go:413-448) --------------------------
+        self.executor = BlockExecutor(
+            self.app_conns.consensus, state_store=self.state_store,
+            block_store=self.block_store, mempool=self.mempool,
+            evidence_pool=self.evidence_pool, event_bus=self.event_bus)
+        cc = config.consensus
+        self.consensus = ConsensusState(
+            ConsensusConfig(
+                timeout_propose=cc.timeout_propose,
+                timeout_propose_delta=cc.timeout_propose_delta,
+                timeout_prevote=cc.timeout_prevote,
+                timeout_prevote_delta=cc.timeout_prevote_delta,
+                timeout_precommit=cc.timeout_precommit,
+                timeout_precommit_delta=cc.timeout_precommit_delta,
+                timeout_commit=cc.timeout_commit,
+                create_empty_blocks=cc.create_empty_blocks),
+            state, self.executor, self.block_store,
+            priv_validator=self.priv_validator,
+            wal=WAL(config.path(cc.wal_file)),
+            name=config.base.moniker)
+        self.consensus.evidence_pool = self.evidence_pool
+
+        # --- reactors + switch (node.go:456-494) -----------------------------
+        self.node_key = node_key or Ed25519PrivKey.generate()
+        self.switch = Switch(self.node_key, self.genesis.chain_id,
+                             config.base.moniker)
+        self.consensus_reactor = ConsensusReactor(self.consensus)
+        self.consensus_reactor.attach(self.switch)
+        self.blocksync_reactor = BlocksyncNetReactor(self.block_store)
+        from ..mempool.reactor import MempoolReactor
+        self.mempool_reactor = MempoolReactor(self.mempool)
+        self.mempool_reactor.attach(self.switch)
+        self.switch.add_reactor(self.consensus_reactor)
+        self.switch.add_reactor(self.blocksync_reactor)
+        self.switch.add_reactor(self.mempool_reactor)
+
+        # --- RPC (node.go:559 — started first on OnStart) --------------------
+        self.rpc_server: Optional[RPCServer] = None
+        if config.rpc.enable:
+            host, port = self._split_addr(config.rpc.laddr)
+            self.rpc_server = RPCServer(RPCEnvironment(
+                chain_id=self.genesis.chain_id,
+                block_store=self.block_store,
+                state_store=self.state_store, mempool=self.mempool,
+                consensus=self.consensus, event_bus=self.event_bus,
+                tx_indexer=self.tx_indexer,
+                block_indexer=self.block_indexer,
+                app_query=self.app_conns.query, genesis=self.genesis,
+                switch=self.switch), host, port)
+
+    @staticmethod
+    def _split_addr(addr: str):
+        host, _, port = addr.rpartition(":")
+        return host or "127.0.0.1", int(port)
+
+    def _handshake(self, state: State) -> None:
+        """ABCI handshake: sync the app to the stored state by replaying
+        blocks it hasn't seen (reference node/node.go:365 doHandshake →
+        internal/consensus/replay.go:242-284)."""
+        info = self.app_conns.consensus.info()
+        app_height = info.last_block_height
+        if app_height == 0:
+            # fresh app: InitChain even when the store is ahead — the
+            # replay below brings it to the stored height
+            self.app_conns.consensus.init_chain(
+                self.genesis.chain_id, self.genesis.initial_height,
+                self.genesis.validators, self.genesis.app_state)
+        # replay stored blocks the app is missing (crash between
+        # SaveBlock and app commit, or a fresh app behind an old store)
+        h = app_height + 1
+        while h <= state.last_block_height:
+            blk = self.block_store.load_block(h)
+            if blk is None:
+                break
+            self.app_conns.consensus.finalize_block(RequestFinalizeBlock(
+                txs=blk.data.txs, height=h, time=blk.header.time,
+                proposer_address=blk.header.proposer_address,
+                hash=blk.hash(),
+                next_validators_hash=blk.header.next_validators_hash))
+            self.app_conns.consensus.commit()
+            h += 1
+
+    # --- lifecycle (node.go:539-609) -----------------------------------------
+
+    def start(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.start()          # RPC first (node.go:559)
+        self.indexer_service.start()
+        host, port = self._split_addr(self.config.p2p.laddr)
+        self.p2p_addr = self.switch.listen(host, port)
+        for peer in filter(None, self.config.p2p.persistent_peers.split(",")):
+            ph, _, pp = peer.strip().rpartition(":")
+            try:
+                self.switch.dial(ph, int(pp))
+            except OSError:
+                pass  # reference retries via ensurePeers; peers also dial us
+        self.consensus.start()
+
+    def stop(self) -> None:
+        self.consensus.stop()
+        self.switch.stop()
+        self.indexer_service.stop()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.app_conns.stop()

@@ -1,0 +1,3 @@
+from .multi_app_conn import AppConns, local_client_creator, remote_client_creator
+
+__all__ = ["AppConns", "local_client_creator", "remote_client_creator"]

@@ -1,0 +1,6 @@
+from .query import Query, QueryError
+from .pubsub import PubSubServer, Subscription
+from .events import EventBus, Event
+
+__all__ = ["Query", "QueryError", "PubSubServer", "Subscription",
+           "EventBus", "Event"]

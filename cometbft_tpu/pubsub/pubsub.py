@@ -1,0 +1,87 @@
+"""Pubsub server: query-filtered subscriptions with bounded buffers
+(reference internal/pubsub/pubsub.go).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .query import Query
+
+
+class SubscriptionError(Exception):
+    pass
+
+
+@dataclass
+class Subscription:
+    subscriber: str
+    query: Query
+    out: "queue.Queue" = dc_field(default_factory=lambda: queue.Queue(100))
+    cancelled: bool = False
+
+    def next(self, timeout: Optional[float] = None):
+        """Blocking read of the next published message; None on cancel."""
+        try:
+            return self.out.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class PubSubServer:
+    """reference pubsub.go Server: subscribe/unsubscribe/publish."""
+
+    def __init__(self):
+        self._subs: Dict[Tuple[str, str], Subscription] = {}
+        self._lock = threading.RLock()
+
+    def subscribe(self, subscriber: str, query: Query,
+                  buffer: int = 100) -> Subscription:
+        key = (subscriber, query.raw)
+        with self._lock:
+            if key in self._subs:
+                raise SubscriptionError(
+                    f"{subscriber} already subscribed to {query.raw!r}")
+            sub = Subscription(subscriber, query,
+                               queue.Queue(buffer))
+            self._subs[key] = sub
+            return sub
+
+    def unsubscribe(self, subscriber: str, query: Query) -> None:
+        with self._lock:
+            sub = self._subs.pop((subscriber, query.raw), None)
+        if sub is not None:
+            sub.cancelled = True
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        with self._lock:
+            keys = [k for k in self._subs if k[0] == subscriber]
+            for k in keys:
+                self._subs.pop(k).cancelled = True
+
+    def publish(self, msg: Any, events: Dict[str, List[str]]) -> None:
+        """Deliver to every matching subscription; a full buffer drops
+        the oldest entry (the reference cancels slow subscribers — for
+        an embedded bus, sliding is friendlier and still bounded)."""
+        with self._lock:
+            subs = list(self._subs.values())
+        for sub in subs:
+            if sub.query.matches(events):
+                try:
+                    sub.out.put_nowait((msg, events))
+                except queue.Full:
+                    try:
+                        sub.out.get_nowait()
+                    except queue.Empty:
+                        pass
+                    try:
+                        sub.out.put_nowait((msg, events))
+                    except queue.Full:
+                        pass
+
+    def num_subscriptions(self) -> int:
+        with self._lock:
+            return len(self._subs)

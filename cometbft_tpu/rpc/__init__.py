@@ -1,0 +1,4 @@
+from .server import RPCServer, RPCEnvironment
+from .client import RPCClient
+
+__all__ = ["RPCServer", "RPCEnvironment", "RPCClient"]

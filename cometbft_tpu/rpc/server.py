@@ -1,0 +1,341 @@
+"""JSON-RPC 2.0 server over HTTP with the core route table
+(reference rpc/jsonrpc/server/http_server.go:56, rpc/core/routes.go,
+rpc/core/env.go).
+
+Both calling conventions the reference supports:
+  POST /            {"jsonrpc":"2.0","method":...,"params":{...},"id":...}
+  GET  /<method>?param=value          (URI convention)
+Binary params are hex strings (the reference uses 0x-hex/base64 per
+field; here hex uniformly). Event subscription is long-poll
+(`wait_event`) rather than a WebSocket push — same pubsub semantics
+behind the node's event bus.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from ..pubsub.query import Query, QueryError
+from ..types.block import tx_hash
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class RPCEnvironment:
+    """Handles the route table reads from (reference rpc/core/env.go)."""
+
+    def __init__(self, chain_id: str, block_store=None, state_store=None,
+                 mempool=None, consensus=None, event_bus=None,
+                 tx_indexer=None, block_indexer=None, app_query=None,
+                 genesis=None, switch=None, state_getter=None):
+        self.chain_id = chain_id
+        self.block_store = block_store
+        self.state_store = state_store
+        self.mempool = mempool
+        self.consensus = consensus
+        self.event_bus = event_bus
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.app_query = app_query
+        self.genesis = genesis
+        self.switch = switch
+        self.state_getter = state_getter or (
+            (lambda: consensus.state) if consensus else (lambda: None))
+
+
+def _block_id_json(bid) -> dict:
+    return {"hash": bid.hash.hex(),
+            "parts": {"total": bid.parts.total,
+                      "hash": bid.parts.hash.hex()}}
+
+
+def _header_json(h) -> dict:
+    return {
+        "chain_id": h.chain_id, "height": h.height,
+        "time": [h.time.seconds, h.time.nanos],
+        "last_block_id": _block_id_json(h.last_block_id),
+        "last_commit_hash": h.last_commit_hash.hex(),
+        "data_hash": h.data_hash.hex(),
+        "validators_hash": h.validators_hash.hex(),
+        "next_validators_hash": h.next_validators_hash.hex(),
+        "consensus_hash": h.consensus_hash.hex(),
+        "app_hash": h.app_hash.hex(),
+        "last_results_hash": h.last_results_hash.hex(),
+        "evidence_hash": h.evidence_hash.hex(),
+        "proposer_address": h.proposer_address.hex(),
+    }
+
+
+class Routes:
+    """reference rpc/core/routes.go — each method maps 1:1."""
+
+    def __init__(self, env: RPCEnvironment):
+        self.env = env
+
+    # --- info ----------------------------------------------------------------
+
+    def health(self) -> dict:
+        return {}
+
+    def status(self) -> dict:
+        env = self.env
+        st = env.state_getter()
+        h = env.block_store.height() if env.block_store else 0
+        meta = env.block_store.load_block_meta(h) if h else None
+        return {
+            "node_info": {"network": env.chain_id},
+            "sync_info": {
+                "latest_block_height": h,
+                "latest_block_hash": (meta[0].hash.hex() if meta else ""),
+                "latest_app_hash": (st.app_hash.hex() if st else ""),
+                "catching_up": False,
+            },
+        }
+
+    def net_info(self) -> dict:
+        peers = self.env.switch.peers() if self.env.switch else []
+        return {"n_peers": len(peers),
+                "peers": [{"node_id": p.id,
+                           "moniker": p.node_info.moniker} for p in peers]}
+
+    def genesis(self) -> dict:
+        g = self.env.genesis
+        if g is None:
+            raise RPCError(-32603, "genesis not available")
+        return {"chain_id": g.chain_id,
+                "initial_height": g.initial_height,
+                "validators": [
+                    {"pub_key": v.pub_key.bytes_().hex(),
+                     "power": v.voting_power} for v in g.validators]}
+
+    # --- blocks --------------------------------------------------------------
+
+    def _height_or_latest(self, height) -> int:
+        h = int(height) if height is not None else \
+            self.env.block_store.height()
+        if not (self.env.block_store.base() <= h
+                <= self.env.block_store.height()):
+            raise RPCError(-32603, f"height {h} not available")
+        return h
+
+    def block(self, height=None) -> dict:
+        h = self._height_or_latest(height)
+        blk = self.env.block_store.load_block(h)
+        meta = self.env.block_store.load_block_meta(h)
+        return {"block_id": _block_id_json(meta[0]),
+                "block": {
+                    "header": _header_json(blk.header),
+                    "data": {"txs": [t.hex() for t in blk.data.txs]},
+                    "evidence": len(blk.evidence),
+                }}
+
+    def blockchain(self, min_height=None, max_height=None) -> dict:
+        top = self.env.block_store.height()
+        lo = int(min_height) if min_height is not None else max(1, top - 19)
+        hi = min(int(max_height) if max_height is not None else top, top)
+        metas = []
+        for h in range(hi, max(lo, self.env.block_store.base()) - 1, -1):
+            m = self.env.block_store.load_block_meta(h)
+            if m is not None:
+                metas.append({"height": h,
+                              "block_id": _block_id_json(m[0])})
+        return {"last_height": top, "block_metas": metas}
+
+    def commit(self, height=None) -> dict:
+        h = self._height_or_latest(height)
+        c = self.env.block_store.load_block_commit(h)
+        if c is None:
+            c = self.env.block_store.load_seen_commit(h)
+        if c is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        return {"height": c.height, "round": c.round,
+                "block_id": _block_id_json(c.block_id),
+                "signatures": len(c.signatures)}
+
+    def validators(self, height=None) -> dict:
+        h = self._height_or_latest(height)
+        vals = (self.env.state_store.load_validators(h)
+                if self.env.state_store else None)
+        if vals is None:
+            raise RPCError(-32603, f"no validator set at height {h}")
+        return {"block_height": h,
+                "validators": [
+                    {"address": v.address.hex(),
+                     "pub_key": v.pub_key.bytes_().hex(),
+                     "voting_power": v.voting_power,
+                     "proposer_priority": v.proposer_priority}
+                    for v in vals.validators]}
+
+    # --- ABCI ----------------------------------------------------------------
+
+    def abci_info(self) -> dict:
+        info = self.env.app_query.info()
+        return {"data": info.data, "version": info.version,
+                "last_block_height": info.last_block_height,
+                "last_block_app_hash": info.last_block_app_hash.hex()}
+
+    def abci_query(self, path="", data="") -> dict:
+        code, value = self.env.app_query.query(path, bytes.fromhex(data))
+        return {"code": code, "value": value.hex()}
+
+    # --- txs -----------------------------------------------------------------
+
+    def broadcast_tx_sync(self, tx="") -> dict:
+        raw = bytes.fromhex(tx)
+        try:
+            code = self.env.mempool.check_tx(raw)
+        except ValueError as e:
+            raise RPCError(-32603, str(e)) from e
+        return {"code": code, "hash": tx_hash(raw).hex().upper()}
+
+    def broadcast_tx_async(self, tx="") -> dict:
+        import threading as _t
+        raw = bytes.fromhex(tx)
+        _t.Thread(target=lambda: self._checked(raw), daemon=True).start()
+        return {"hash": tx_hash(raw).hex().upper()}
+
+    def _checked(self, raw: bytes) -> None:
+        try:
+            self.env.mempool.check_tx(raw)
+        except ValueError:
+            pass
+
+    def unconfirmed_txs(self, limit=None) -> dict:
+        n = int(limit) if limit is not None else 30
+        txs = self.env.mempool.reap_max_txs(n)
+        return {"n_txs": len(txs), "total": self.env.mempool.size(),
+                "total_bytes": self.env.mempool.size_bytes(),
+                "txs": [t.hex() for t in txs]}
+
+    def tx(self, hash="") -> dict:
+        got = self.env.tx_indexer.get(bytes.fromhex(hash))
+        if got is None:
+            raise RPCError(-32603, f"tx {hash} not found")
+        height, index, raw, code = got
+        return {"hash": hash, "height": height, "index": index,
+                "tx": raw.hex(), "tx_result": {"code": code}}
+
+    def tx_search(self, query="", limit=None) -> dict:
+        try:
+            q = Query(query)
+        except QueryError as e:
+            raise RPCError(-32602, f"bad query: {e}") from e
+        hashes = self.env.tx_indexer.search(
+            q, int(limit) if limit else 100)
+        out = []
+        for hsh in hashes:
+            got = self.env.tx_indexer.get(hsh)
+            if got:
+                out.append({"hash": hsh.hex().upper(), "height": got[0],
+                            "index": got[1], "tx": got[2].hex()})
+        return {"txs": out, "total_count": len(out)}
+
+    def block_search(self, query="", limit=None) -> dict:
+        try:
+            q = Query(query)
+        except QueryError as e:
+            raise RPCError(-32602, f"bad query: {e}") from e
+        heights = self.env.block_indexer.search(
+            q, int(limit) if limit else 100)
+        return {"blocks": [self.block(h) for h in heights],
+                "total_count": len(heights)}
+
+    # --- events (long-poll stand-in for the WS subscription) ------------------
+
+    def wait_event(self, query="", timeout=None) -> dict:
+        try:
+            q = Query(query)
+        except QueryError as e:
+            raise RPCError(-32602, f"bad query: {e}") from e
+        sub = self.env.event_bus.subscribe(f"rpc-{id(q)}", q)
+        try:
+            got = sub.next(float(timeout) if timeout else 10.0)
+            if got is None:
+                return {"event": None}
+            event, attrs = got
+            return {"event": {"kind": event.kind, "attrs": attrs}}
+        finally:
+            self.env.event_bus.unsubscribe_all(f"rpc-{id(q)}")
+
+
+class RPCServer:
+    def __init__(self, env: RPCEnvironment, host: str = "127.0.0.1",
+                 port: int = 0):
+        routes = Routes(env)
+        methods: Dict[str, Callable] = {
+            name: getattr(routes, name) for name in (
+                "health", "status", "net_info", "genesis", "block",
+                "blockchain", "commit", "validators", "abci_info",
+                "abci_query", "broadcast_tx_sync", "broadcast_tx_async",
+                "unconfirmed_txs", "tx", "tx_search", "block_search",
+                "wait_event")}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence
+                pass
+
+            def _reply(self, payload: dict, rid=None):
+                body = json.dumps({"jsonrpc": "2.0", "id": rid,
+                                   **payload}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _run(self, method: str, params: dict, rid):
+                fn = methods.get(method)
+                if fn is None:
+                    self._reply({"error": {"code": -32601,
+                                           "message": f"unknown method "
+                                           f"{method}"}}, rid)
+                    return
+                try:
+                    self._reply({"result": fn(**params)}, rid)
+                except RPCError as e:
+                    self._reply({"error": {"code": e.code,
+                                           "message": e.message}}, rid)
+                except Exception as e:  # noqa: BLE001
+                    self._reply({"error": {"code": -32603,
+                                           "message": str(e)}}, rid)
+
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length", "0"))
+                try:
+                    req = json.loads(self.rfile.read(ln) or b"{}")
+                except json.JSONDecodeError:
+                    self._reply({"error": {"code": -32700,
+                                           "message": "parse error"}})
+                    return
+                self._run(req.get("method", ""), req.get("params") or {},
+                          req.get("id"))
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                method = parsed.path.strip("/")
+                params = {k: v[0] for k, v in
+                          urllib.parse.parse_qs(parsed.query).items()}
+                self._run(method or "health", params, -1)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rpc-server",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

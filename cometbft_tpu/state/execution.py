@@ -94,12 +94,13 @@ class BlockExecutor:
     :109 CreateProposalBlock."""
 
     def __init__(self, app: Application, state_store=None, block_store=None,
-                 mempool=None, evidence_pool=None):
+                 mempool=None, evidence_pool=None, event_bus=None):
         self.app = app
         self.state_store = state_store
         self.block_store = block_store
         self.mempool = mempool
         self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
 
     # --- proposal path ------------------------------------------------------
 
@@ -182,6 +183,18 @@ class BlockExecutor:
 
         if self.state_store is not None:
             self.state_store.save(new_state)
+
+        # fireEvents (reference state/execution.go:324-389): block, per-tx,
+        # and valset-update events to the bus → indexers, RPC subscribers
+        if self.event_bus is not None:
+            self.event_bus.publish_new_block(block, resp)
+            self.event_bus.publish_new_block_header(block.header)
+            for i, tx in enumerate(block.data.txs):
+                self.event_bus.publish_tx(block.header.height, i, tx,
+                                          resp.tx_results[i])
+            if resp.validator_updates:
+                self.event_bus.publish_validator_set_updates(
+                    resp.validator_updates)
         return new_state, resp
 
     def _update_state(self, state: State, block_id: BlockID, block: Block,

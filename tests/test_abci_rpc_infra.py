@@ -1,0 +1,177 @@
+"""ABCI socket client/server + proxy, pubsub query language, event bus,
+and kv indexers (reference abci/tests, internal/pubsub/query/query_test.go,
+state/txindex/kv/kv_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci.application import RequestFinalizeBlock
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.abci.socket import ABCIServer, SocketClient
+from cometbft_tpu.db.kv import MemDB
+from cometbft_tpu.indexer.kv import BlockIndexer, IndexerService, TxIndexer
+from cometbft_tpu.proxy.multi_app_conn import (
+    AppConns, local_client_creator, remote_client_creator)
+from cometbft_tpu.pubsub.events import EventBus
+from cometbft_tpu.pubsub.pubsub import PubSubServer
+from cometbft_tpu.pubsub.query import Query, QueryError
+from cometbft_tpu.types.proto import Timestamp
+
+
+# --- query language ----------------------------------------------------------
+
+def test_query_parse_and_match():
+    q = Query("tm.event = 'Tx' AND tx.height > 5")
+    assert q.matches({"tm.event": ["Tx"], "tx.height": ["6"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["5"]})
+    assert not q.matches({"tm.event": ["NewBlock"], "tx.height": ["9"]})
+    # multiple values per tag: ANY match counts
+    assert q.matches({"tm.event": ["Other", "Tx"], "tx.height": ["9"]})
+
+    assert Query("account.owner CONTAINS 'ivan'").matches(
+        {"account.owner": ["ivan.petrov"]})
+    assert Query("tx.hash EXISTS").matches({"tx.hash": ["AB"]})
+    assert not Query("tx.hash EXISTS").matches({"other": ["x"]})
+
+    with pytest.raises(QueryError):
+        Query("tm.event = ")
+    with pytest.raises(QueryError):
+        Query("AND tm.event = 'Tx'")
+    with pytest.raises(QueryError):
+        Query("")
+
+
+def test_pubsub_filtered_delivery():
+    srv = PubSubServer()
+    s1 = srv.subscribe("a", Query("tm.event = 'Tx'"))
+    s2 = srv.subscribe("a", Query("tm.event = 'NewBlock'"))
+    srv.publish("m1", {"tm.event": ["Tx"]})
+    srv.publish("m2", {"tm.event": ["NewBlock"]})
+    assert s1.next(1)[0] == "m1"
+    assert s2.next(1)[0] == "m2"
+    assert s1.out.empty() and s2.out.empty()
+    srv.unsubscribe_all("a")
+    assert srv.num_subscriptions() == 0
+
+
+# --- ABCI socket + proxy ------------------------------------------------------
+
+def _finalize(client, height, txs):
+    return client.finalize_block(RequestFinalizeBlock(
+        txs=txs, height=height, time=Timestamp(100 + height, 0),
+        proposer_address=b"\x01" * 20, hash=b"\x02" * 32,
+        next_validators_hash=b"\x03" * 32))
+
+
+def test_abci_socket_roundtrip():
+    app = KVStoreApplication()
+    server = ABCIServer(app)
+    server.start()
+    host, port = server.addr
+    try:
+        client = SocketClient(host, port)
+        _updates, app_hash = client.init_chain("sock-chain", 1, [], b"")
+        assert app_hash == app._compute_app_hash({}, 0)
+        assert client.info().data == "kvstore-tpu"
+        assert client.check_tx(b"a=1").code == 0
+        assert client.check_tx(b"garbage").code != 0
+        assert client.process_proposal([b"a=1"], 1)
+        resp = _finalize(client, 1, [b"a=1", b"b=2"])
+        assert [r.code for r in resp.tx_results] == [0, 0]
+        client.commit()
+        assert client.query("/store", b"a") == (0, b"1")
+        # remote app state == direct app state
+        assert app.state == {"a": "1", "b": "2"}
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_proxy_four_connections_remote_and_local():
+    app = KVStoreApplication()
+    server = ABCIServer(app)
+    server.start()
+    host, port = server.addr
+    try:
+        conns = AppConns(remote_client_creator(host, port))
+        conns.consensus.init_chain("sock-chain", 1, [], b"")
+        # concurrent query on the query conn while consensus finalizes
+        _finalize(conns.consensus, 1, [b"x=9"])
+        conns.consensus.commit()
+        assert conns.query.query("/store", b"x") == (0, b"9")
+        assert conns.mempool.check_tx(b"y=1").code == 0
+        conns.stop()
+    finally:
+        server.stop()
+
+    local = AppConns(local_client_creator(KVStoreApplication()))
+    local.consensus.init_chain("c", 1, [], b"")
+    _finalize(local.consensus, 1, [b"k=v"])
+    local.consensus.commit()
+    assert local.query.query("/store", b"k") == (0, b"v")
+
+
+# --- event bus + indexer ------------------------------------------------------
+
+def test_event_bus_to_indexer_flow():
+    bus = EventBus()
+    txi = TxIndexer(MemDB())
+    bki = BlockIndexer(MemDB())
+    svc = IndexerService(txi, bki, bus)
+    svc.start()
+    try:
+        from cometbft_tpu.engine.chain_gen import generate_chain
+        chain = generate_chain(3, n_validators=4, txs_per_block=2)
+
+        class _Res:
+            code = 0
+            events = [("transfer", [("sender", "alice")])]
+
+        for h, blk in enumerate(chain.blocks, start=1):
+            bus.publish_new_block(blk, None)
+            for i, tx in enumerate(blk.data.txs):
+                bus.publish_tx(h, i, tx, _Res())
+
+        import hashlib
+        target = chain.blocks[1].data.txs[0]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if txi.get(hashlib.sha256(target).digest()) is not None:
+                break
+            time.sleep(0.02)
+        rec = txi.get(hashlib.sha256(target).digest())
+        assert rec is not None and rec[0] == 2 and rec[2] == target
+
+        # search by height and by app attribute
+        got = txi.search(Query("tx.height = 2"))
+        assert len(got) == 2
+        got = txi.search(Query("transfer.sender = 'alice' AND tx.height > 2"))
+        assert len(got) == 2  # the two txs at height 3
+        assert bki.search(Query("block.height > 1")) == [2, 3]
+    finally:
+        svc.stop()
+
+
+def test_executor_fires_events():
+    """BlockExecutor.apply_block publishes NewBlock + Tx events."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.engine.chain_gen import generate_chain
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State
+
+    bus = EventBus()
+    sub_blk = bus.subscribe("t", Query("tm.event = 'NewBlock'"))
+    sub_tx = bus.subscribe("t", Query("tm.event = 'Tx' AND tx.height = 1"))
+    chain = generate_chain(1, n_validators=4, txs_per_block=1)
+    app = KVStoreApplication()
+    app.init_chain(chain.chain_id, 1, [], b"")
+    ex = BlockExecutor(app, event_bus=bus)
+    state = State.from_genesis(chain.genesis)
+    ex.apply_block(state, chain.block_ids[0], chain.blocks[0],
+                   verified=True)
+    ev, attrs = sub_blk.next(1)
+    assert attrs["block.height"] == ["1"]
+    ev, attrs = sub_tx.next(1)
+    assert attrs["tx.height"] == ["1"]
