@@ -119,6 +119,17 @@ class ResponseCommit:
     retain_height: int = 0
 
 
+@dataclass
+class Snapshot:
+    """reference abci Snapshot message (statesync.proto): an app-level
+    checkpoint advertised to catching-up peers."""
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+
 class Application(Protocol):
     """reference abci/types/application.go:9-35."""
 

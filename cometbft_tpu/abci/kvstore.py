@@ -15,8 +15,8 @@ from typing import List
 
 from .application import (
     BaseApplication, CheckTxResult, ExecTxResult, RequestFinalizeBlock,
-    ResponseCommit, ResponseFinalizeBlock, ResponseInfo, ValidatorUpdate,
-    CODE_TYPE_OK,
+    ResponseCommit, ResponseFinalizeBlock, ResponseInfo, Snapshot,
+    ValidatorUpdate, CODE_TYPE_OK,
 )
 
 CODE_TYPE_INVALID_FORMAT = 1
@@ -126,3 +126,75 @@ class KVStoreApplication(BaseApplication):
             v = self.state.get(data.decode(errors="replace"))
             return CODE_TYPE_OK, (v.encode() if v is not None else b"")
         return 1, b"unknown path"
+
+    # --- statesync snapshots (reference kvstore.go snapshot support) ---------
+
+    SNAPSHOT_CHUNK_SIZE = 1 << 16
+
+    def _snapshot_blob(self) -> bytes:
+        return json.dumps({"state": {k: self.state[k]
+                                     for k in sorted(self.state)},
+                           "height": self.last_height},
+                          separators=(",", ":")).encode()
+
+    def list_snapshots(self) -> List[Snapshot]:
+        """One snapshot of the current committed state, with its blob
+        CAPTURED at advertise time — chunks must stay byte-stable while
+        later blocks commit, or the restorer's hash check fails (the
+        reference snapshots to disk on an interval for the same reason).
+        """
+        if self.last_height == 0:
+            return []
+        blob = self._snapshot_blob()
+        if not hasattr(self, "_snapshot_blobs"):
+            self._snapshot_blobs = {}
+        self._snapshot_blobs[self.last_height] = blob
+        n = max(1, (len(blob) + self.SNAPSHOT_CHUNK_SIZE - 1)
+                // self.SNAPSHOT_CHUNK_SIZE)
+        return [Snapshot(height=self.last_height, format=1, chunks=n,
+                         hash=hashlib.sha256(blob).digest())]
+
+    def load_snapshot_chunk(self, height: int, format_: int,
+                            chunk: int) -> bytes:
+        blob = getattr(self, "_snapshot_blobs", {}).get(height)
+        if blob is None:
+            return b""  # unknown snapshot: restorer will RETRY elsewhere
+        lo = chunk * self.SNAPSHOT_CHUNK_SIZE
+        return blob[lo:lo + self.SNAPSHOT_CHUNK_SIZE]
+
+    def offer_snapshot(self, snapshot: Snapshot, app_hash: bytes) -> str:
+        if snapshot.format != 1 or snapshot.chunks < 1:
+            return "REJECT_FORMAT"
+        self._restore = {"snapshot": snapshot, "chunks": [],
+                         "app_hash": app_hash}
+        return "ACCEPT"
+
+    def apply_snapshot_chunk(self, index: int, chunk: bytes,
+                             sender: str) -> str:
+        r = getattr(self, "_restore", None)
+        if r is None:
+            return "ABORT"
+        # position by index: sources may re-deliver or reorder chunks
+        # (the reference chunk queue slots by index the same way)
+        if index < len(r["chunks"]):
+            return "ACCEPT"  # duplicate: already have it
+        if index > len(r["chunks"]):
+            return "RETRY_SNAPSHOT"  # gap: restart this snapshot
+        r["chunks"].append(chunk)
+        if len(r["chunks"]) < r["snapshot"].chunks:
+            return "ACCEPT"
+        blob = b"".join(r["chunks"])
+        if hashlib.sha256(blob).digest() != r["snapshot"].hash:
+            self._restore = None
+            return "RETRY_SNAPSHOT"
+        d = json.loads(blob)
+        state, height = d["state"], d["height"]
+        if self._compute_app_hash(state, height) != r["app_hash"]:
+            # light-client-verified app hash disagrees: poisoned snapshot
+            self._restore = None
+            return "REJECT_SNAPSHOT"
+        self.state = state
+        self.last_height = height
+        self.last_app_hash = r["app_hash"]
+        self._restore = None
+        return "COMPLETE"

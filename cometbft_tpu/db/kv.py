@@ -1,11 +1,13 @@
 """Key-value storage backends (the cometbft-db seam, reference go.mod:42,
 node/node.go:284).
 
-Two built-in backends:
+Three built-in backends:
 - MemDB: ordered in-memory map (the memdb analog used across tests),
 - FileDB: append-only log + in-memory index with compaction — a simple
-  durable store. (A C++ LSM backend slots in behind the same interface;
-  see db/native.)
+  durable store in pure Python,
+- NativeDB (db/native): the same record format implemented in C++
+  (kvlog.cc, ctypes-bound) — the production storage path, file-
+  compatible with FileDB.
 
 Iteration is ordered by raw bytes, matching goleveldb semantics the
 reference relies on for height-ordered scans.
@@ -174,4 +176,7 @@ def open_db(backend: str, name: str, directory: str) -> KVStore:
         return MemDB()
     if backend == "filedb":
         return FileDB(os.path.join(directory, f"{name}.db"))
+    if backend == "native":
+        from .native import NativeDB
+        return NativeDB(os.path.join(directory, f"{name}.db"))
     raise ValueError(f"unknown db backend {backend!r}")

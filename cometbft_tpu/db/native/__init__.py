@@ -1,0 +1,3 @@
+from .wrapper import NativeDB, native_available
+
+__all__ = ["NativeDB", "native_available"]

@@ -1,0 +1,147 @@
+"""ctypes bindings for the C++ append-log KV backend (kvlog.cc).
+
+The shared library is built on first use with g++ (cached beside the
+source, rebuilt when the source is newer). File format is identical to
+db.kv.FileDB, so the two backends can open each other's files.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "kvlog.cc")
+_SO = os.path.join(_DIR, "kvlog.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> None:
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC],
+        check=True, capture_output=True)
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.nkv_open.restype = ctypes.c_void_p
+        lib.nkv_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.nkv_close.argtypes = [ctypes.c_void_p]
+        lib.nkv_set.restype = ctypes.c_int
+        lib.nkv_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_size_t, ctypes.c_char_p,
+                                ctypes.c_size_t]
+        lib.nkv_del.restype = ctypes.c_int
+        lib.nkv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_size_t]
+        lib.nkv_get.restype = ctypes.c_int64
+        lib.nkv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_size_t,
+                                ctypes.POINTER(ctypes.POINTER(
+                                    ctypes.c_uint8))]
+        lib.nkv_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.nkv_size.restype = ctypes.c_int64
+        lib.nkv_size.argtypes = [ctypes.c_void_p]
+        lib.nkv_iter.restype = ctypes.c_void_p
+        lib.nkv_iter.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_size_t, ctypes.c_char_p,
+                                 ctypes.c_size_t]
+        lib.nkv_iter_next.restype = ctypes.c_int
+        lib.nkv_iter_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.nkv_iter_close.argtypes = [ctypes.c_void_p]
+        lib.nkv_compact.restype = ctypes.c_int
+        lib.nkv_compact.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+class NativeDB:
+    """KVStore over the C++ backend (same seam as MemDB/FileDB)."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self._lib = _load()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._h = self._lib.nkv_open(path.encode(), 1 if fsync else 0)
+        if not self._h:
+            raise OSError(f"nkv_open failed for {path}")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.nkv_get(self._h, key, len(key), ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.nkv_free(out)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if self._lib.nkv_set(self._h, key, len(key), value,
+                             len(value)) != 0:
+            raise OSError("nkv_set failed")
+
+    def delete(self, key: bytes) -> None:
+        if self._lib.nkv_del(self._h, key, len(key)) != 0:
+            raise OSError("nkv_del failed")
+
+    def iterate(self, start: bytes = b"",
+                end: Optional[bytes] = None
+                ) -> Iterator[Tuple[bytes, bytes]]:
+        it = self._lib.nkv_iter(self._h, start, len(start),
+                                end or b"", len(end or b""))
+        try:
+            k = ctypes.POINTER(ctypes.c_uint8)()
+            v = ctypes.POINTER(ctypes.c_uint8)()
+            klen = ctypes.c_size_t()
+            vlen = ctypes.c_size_t()
+            while self._lib.nkv_iter_next(
+                    it, ctypes.byref(k), ctypes.byref(klen),
+                    ctypes.byref(v), ctypes.byref(vlen)):
+                yield (ctypes.string_at(k, klen.value),
+                       ctypes.string_at(v, vlen.value))
+        finally:
+            self._lib.nkv_iter_close(it)
+
+    def write_batch(self, sets: List[Tuple[bytes, bytes]],
+                    deletes: List[bytes] = ()) -> None:
+        for k, v in sets:
+            self.set(k, v)
+        for k in deletes:
+            self.delete(k)
+
+    def compact(self) -> None:
+        if self._lib.nkv_compact(self._h) != 0:
+            raise OSError("nkv_compact failed")
+
+    def __len__(self) -> int:
+        return int(self._lib.nkv_size(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.nkv_close(self._h)
+            self._h = None

@@ -188,8 +188,9 @@ class LightClient:
             pivots.pop()
 
     def _verify_backwards(self, height: int) -> LightBlock:
-        """Hash-linked walk to an earlier height (client.go:934-988)."""
-        cur = self.store.lowest()
+        """Hash-linked walk down from the closest trusted header above
+        (client.go:934-988)."""
+        cur = self.store.lowest_above(height)
         while cur is not None and cur.height > height:
             prev = self.primary.light_block(cur.height - 1)
             prev.validate_basic(self.chain_id)

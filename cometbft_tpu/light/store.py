@@ -12,6 +12,7 @@ from .types import LightBlock, SignedHeader
 from ..types import proto
 
 _PREFIX = b"lb:"
+_END = _PREFIX + b"\xff" * 9  # past any 8-byte big-endian height key
 
 
 def _key(height: int) -> bytes:
@@ -40,20 +41,23 @@ class LightStore:
 
     def latest(self) -> Optional[LightBlock]:
         last = None
-        for _k, _v in self._db.iterate(_PREFIX, _PREFIX + b"\xff" * 9):
+        for _k, _v in self._db.iterate(_PREFIX, _END):
             last = _k
         if last is None:
             return None
         return self.light_block(int.from_bytes(last[len(_PREFIX):], "big"))
 
     def lowest(self) -> Optional[LightBlock]:
-        for k, _v in self._db.iterate(_PREFIX, _PREFIX + b"\xff" * 9):
+        return self.lowest_above(0)
+
+    def lowest_above(self, height: int) -> Optional[LightBlock]:
+        """The lowest trusted block with height >= `height`."""
+        for k, _v in self._db.iterate(_key(height), _END):
             return self.light_block(int.from_bytes(k[len(_PREFIX):], "big"))
         return None
 
     def prune(self, keep: int) -> None:
         """Keep the `keep` highest blocks (reference db.go Prune)."""
-        keys = [k for k, _ in self._db.iterate(_PREFIX,
-                                               _PREFIX + b"\xff" * 9)]
+        keys = [k for k, _ in self._db.iterate(_PREFIX, _END)]
         for k in keys[:max(0, len(keys) - keep)]:
             self._db.delete(k)

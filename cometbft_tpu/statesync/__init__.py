@@ -1,0 +1,5 @@
+from .syncer import Syncer, SnapshotSource, StateSyncError
+from .stateprovider import LightStateProvider
+
+__all__ = ["Syncer", "SnapshotSource", "StateSyncError",
+           "LightStateProvider"]

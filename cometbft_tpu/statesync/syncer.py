@@ -1,0 +1,110 @@
+"""Statesync syncer: discover snapshots, offer to the app, stream chunks,
+verify against the light-client trust anchor, bootstrap state
+(reference internal/statesync/syncer.go:324-366, snapshots.go, chunks.go).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Protocol, Tuple
+
+from ..abci.application import Snapshot
+from ..state.state import State
+
+
+class StateSyncError(Exception):
+    pass
+
+
+class SnapshotSource(Protocol):
+    """Where snapshots/chunks come from — an in-process app, or the p2p
+    statesync channel (the reference's per-peer snapshot requests)."""
+
+    def list_snapshots(self) -> List[Snapshot]: ...
+    def fetch_chunk(self, height: int, format_: int,
+                    chunk: int) -> bytes: ...
+
+
+class AppSnapshotSource:
+    """Serve snapshots straight from a peer's Application (the
+    in-process stand-in for the statesync channel)."""
+
+    def __init__(self, app):
+        self.app = app
+
+    def list_snapshots(self) -> List[Snapshot]:
+        return self.app.list_snapshots()
+
+    def fetch_chunk(self, height: int, format_: int, chunk: int) -> bytes:
+        return self.app.load_snapshot_chunk(height, format_, chunk)
+
+
+class Syncer:
+    """reference internal/statesync/syncer.go syncer."""
+
+    def __init__(self, app, state_provider, sources: List[SnapshotSource]):
+        self.app = app
+        self.state_provider = state_provider
+        self.sources = list(sources)
+
+    def discover(self) -> List[Tuple[Snapshot, SnapshotSource]]:
+        """Collect candidate snapshots, best (highest) first
+        (snapshots.go snapshotPool.Best)."""
+        found = []
+        for src in self.sources:
+            try:
+                for snap in src.list_snapshots():
+                    found.append((snap, src))
+            except Exception:  # noqa: BLE001 — a bad peer must not
+                continue  # abort discovery (reference drops the peer)
+        found.sort(key=lambda s: (-s[0].height, s[0].format))
+        return found
+
+    def sync(self) -> State:
+        """Try candidates until one restores (syncer.go:324 SyncAny).
+        Returns the bootstrapped State; the caller hands it to consensus
+        or blocksync for the remaining heights."""
+        candidates = self.discover()
+        if not candidates:
+            raise StateSyncError("no snapshots discovered")
+        last_err: Optional[Exception] = None
+        for snap, src in candidates:
+            try:
+                return self._try_one(snap, src)
+            except Exception as e:  # noqa: BLE001 — a bad candidate or
+                # flaky source must not abort the sync; try the next one
+                last_err = e
+        raise StateSyncError(f"all snapshots failed: {last_err}")
+
+    def _try_one(self, snap: Snapshot, src: SnapshotSource) -> State:
+        # trust anchor AND bootstrap state FIRST: both only read the
+        # light client, so an unanchorable candidate (e.g. too close to
+        # the tip for the height+2 header) fails BEFORE the app mutates
+        # (syncer.go:366 verifies before applying chunks)
+        try:
+            app_hash = self.state_provider.app_hash(snap.height)
+            boot_state = self.state_provider.state(snap.height)
+        except Exception as e:  # provider/light errors: unanchorable
+            raise StateSyncError(
+                f"cannot anchor snapshot at {snap.height}: {e}") from e
+        verdict = self.app.offer_snapshot(snap, app_hash)
+        if verdict != "ACCEPT":
+            raise StateSyncError(f"app rejected snapshot: {verdict}")
+        for i in range(snap.chunks):
+            chunk = src.fetch_chunk(snap.height, snap.format, i)
+            verdict = self.app.apply_snapshot_chunk(i, chunk, "")
+            if verdict == "ACCEPT":
+                continue
+            if verdict == "COMPLETE":
+                break
+            raise StateSyncError(
+                f"chunk {i} verdict {verdict} — snapshot abandoned")
+        else:
+            raise StateSyncError("chunks exhausted without COMPLETE")
+
+        # app restored: double-check Info agrees with the anchor
+        info = self.app.info()
+        if info.last_block_height != snap.height or \
+                info.last_block_app_hash != app_hash:
+            raise StateSyncError(
+                "restored app disagrees with light-verified app hash")
+        return boot_state

@@ -1,0 +1,87 @@
+"""Statesync: snapshot restore with a light-client trust anchor
+(reference internal/statesync/syncer_test.go shape, compressed): a fresh
+node skips execution entirely, restores the app at the snapshot height,
+and bootstraps consensus-ready state."""
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.db.kv import MemDB
+from cometbft_tpu.engine.chain_gen import generate_chain
+from cometbft_tpu.light import LightClient, LightStore, TrustOptions
+from cometbft_tpu.statesync.stateprovider import LightStateProvider
+from cometbft_tpu.statesync.syncer import (
+    AppSnapshotSource, StateSyncError, Syncer)
+from cometbft_tpu.types.proto import Timestamp
+
+from test_light import ChainProvider
+
+CHAIN_LEN = 12
+SNAP_HEIGHT = 10  # the serving app's committed height; headers 11,12
+                  # remain above it for the light-client anchor
+
+
+@pytest.fixture(scope="module")
+def net():
+    chain = generate_chain(CHAIN_LEN, n_validators=4, txs_per_block=2)
+    # a full node stopped at SNAP_HEIGHT (snapshots trail the chain tip,
+    # like the reference's interval snapshots)
+    app = KVStoreApplication()
+    app.init_chain(chain.chain_id, 1, [], b"")
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State
+    ex = BlockExecutor(app)
+    st = State.from_genesis(chain.genesis)
+    for h in range(1, SNAP_HEIGHT + 1):
+        st, _ = ex.apply_block(st, chain.block_ids[h - 1],
+                               chain.blocks[h - 1], verified=True)
+    return chain, app, st
+
+
+def _light_client(chain):
+    prov = ChainProvider(chain)
+    opts = TrustOptions(period_seconds=10**9, height=1,
+                        hash=chain.blocks[0].hash())
+    return LightClient(chain.chain_id, opts, prov, [],
+                       LightStore(MemDB()),
+                       now_fn=lambda: Timestamp(
+                           1_700_000_000 + chain.max_height() + 5, 0))
+
+
+def test_statesync_restores_app_and_state(net):
+    chain, serving_app, full_state = net
+    fresh_app = KVStoreApplication()
+    provider = LightStateProvider(_light_client(chain), chain.genesis)
+    syncer = Syncer(fresh_app, provider, [AppSnapshotSource(serving_app)])
+    state = syncer.sync()
+
+    assert fresh_app.state == serving_app.state
+    assert state.last_block_height == SNAP_HEIGHT == fresh_app.last_height
+    assert state.app_hash == fresh_app.last_app_hash
+    # bootstrapped validator set is the one that signs SNAP_HEIGHT+1
+    assert state.validators.hash() == chain.valsets[SNAP_HEIGHT].hash()
+
+
+def test_statesync_rejects_tampered_snapshot(net):
+    chain, serving_app, _ = net
+
+    class TamperedSource(AppSnapshotSource):
+        def fetch_chunk(self, height, format_, chunk):
+            raw = super().fetch_chunk(height, format_, chunk)
+            return raw[:-1] + bytes([raw[-1] ^ 1])
+
+    fresh_app = KVStoreApplication()
+    provider = LightStateProvider(_light_client(chain), chain.genesis)
+    syncer = Syncer(fresh_app, provider,
+                    [TamperedSource(serving_app)])
+    with pytest.raises(StateSyncError):
+        syncer.sync()
+    assert fresh_app.state == {}  # nothing restored
+
+
+def test_statesync_no_snapshots():
+    fresh_app = KVStoreApplication()
+    syncer = Syncer(fresh_app, None, [AppSnapshotSource(
+        KVStoreApplication())])  # empty app: no snapshots
+    with pytest.raises(StateSyncError):
+        syncer.sync()
