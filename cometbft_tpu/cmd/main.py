@@ -1,0 +1,232 @@
+"""CLI (reference cmd/cometbft/commands/: init, start, testnet, show-*,
+rollback, reset, inspect, light, compact).
+
+    python -m cometbft_tpu.cmd.main init --home DIR
+    python -m cometbft_tpu.cmd.main start --home DIR
+    python -m cometbft_tpu.cmd.main testnet --v 4 --o DIR
+    python -m cometbft_tpu.cmd.main rollback --home DIR [--hard]
+    python -m cometbft_tpu.cmd.main reset --home DIR
+    python -m cometbft_tpu.cmd.main show-node-id --home DIR
+    python -m cometbft_tpu.cmd.main show-validator --home DIR
+    python -m cometbft_tpu.cmd.main inspect --home DIR
+    python -m cometbft_tpu.cmd.main compact --home DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+
+def _cfg(home: str):
+    from ..config import Config
+    path = os.path.join(home, "config/config.toml")
+    if os.path.exists(path):
+        return Config.load(home)
+    cfg = Config(root_dir=home)
+    return cfg
+
+
+def cmd_init(args) -> int:
+    """reference commands/init.go: config + genesis + privval + node key."""
+    from ..config import Config
+    from ..privval.file import FilePV
+    from ..node.node import save_genesis
+    from ..state.state import GenesisDoc
+    from ..types.validator import Validator
+    home = args.home
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    cfg = Config(root_dir=home)
+    if args.chain_id:
+        cfg.base.chain_id = args.chain_id
+    cfg.write()
+    pv = FilePV.load_or_generate(cfg.path(cfg.base.priv_validator_file))
+    gen_path = cfg.path(cfg.base.genesis_file)
+    if not os.path.exists(gen_path):
+        save_genesis(GenesisDoc(
+            chain_id=cfg.base.chain_id,
+            validators=[Validator(pv.get_pub_key(), 10)]), gen_path)
+    print(f"initialized node home at {home}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """reference commands/run_node.go."""
+    from ..abci.kvstore import KVStoreApplication
+    from ..node.node import Node
+    cfg = _cfg(args.home)
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    node = Node(cfg, KVStoreApplication())
+    node.start()
+    print(f"node started: p2p={node.p2p_addr} "
+          f"rpc={node.rpc_server.addr if node.rpc_server else None}",
+          flush=True)
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """reference commands/testnet.go: write N validator homes sharing a
+    genesis."""
+    from ..config import Config
+    from ..privval.file import FilePV
+    from ..node.node import save_genesis
+    from ..state.state import GenesisDoc
+    from ..types.validator import Validator
+    n = args.v
+    pvs, vals = [], []
+    for i in range(n):
+        home = os.path.join(args.o, f"node{i}")
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        cfg = Config(root_dir=home)
+        cfg.base.chain_id = args.chain_id
+        cfg.base.moniker = f"node{i}"
+        cfg.write()
+        pv = FilePV.load_or_generate(
+            cfg.path(cfg.base.priv_validator_file))
+        pvs.append(pv)
+        vals.append(Validator(pv.get_pub_key(), 10))
+    order = sorted(range(n), key=lambda i: vals[i].address)
+    gen = GenesisDoc(chain_id=args.chain_id,
+                     validators=[vals[i] for i in order])
+    for i in range(n):
+        save_genesis(gen, os.path.join(args.o, f"node{i}",
+                                       "config/genesis.json"))
+    print(f"wrote {n} node homes under {args.o}")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """reference commands/rollback.go."""
+    from ..db.kv import open_db
+    from ..state.rollback import rollback_state
+    from ..state.state import StateStore
+    from ..store.blockstore import BlockStore
+    cfg = _cfg(args.home)
+    ddir = cfg.path(cfg.base.db_dir)
+    ss = StateStore(open_db(cfg.base.db_backend, "state", ddir))
+    bs = BlockStore(open_db(cfg.base.db_backend, "blockstore", ddir))
+    state = rollback_state(ss, bs, remove_block=args.hard)
+    print(f"rolled back to height {state.last_block_height} "
+          f"(app_hash {state.app_hash.hex()[:16]})")
+    return 0
+
+
+def cmd_reset(args) -> int:
+    """reference commands/reset.go unsafe-reset-all: wipe data, keep the
+    privval key but reset its sign state carefully — we keep the state
+    (never reset a double-sign guard automatically)."""
+    cfg = _cfg(args.home)
+    ddir = cfg.path(cfg.base.db_dir)
+    if os.path.isdir(ddir):
+        shutil.rmtree(ddir)
+    os.makedirs(ddir, exist_ok=True)
+    print(f"reset data dir {ddir} (privval sign-state preserved)")
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    """The P2P identity (from the persisted node key, NOT the validator
+    privval key — they are different identities, p2p/node_key.go)."""
+    from ..node.node import load_or_generate_node_key
+    cfg = _cfg(args.home)
+    key = load_or_generate_node_key(cfg.path(cfg.base.node_key_file))
+    print(key.pub_key().address().hex())
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from ..privval.file import FilePV
+    cfg = _cfg(args.home)
+    pv = FilePV.load_or_generate(cfg.path(cfg.base.priv_validator_file))
+    print(json.dumps({"type": "ed25519",
+                      "value": pv.get_pub_key().bytes_().hex()}))
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """reference internal/inspect: read-only view over a stopped node's
+    data dirs."""
+    from ..db.kv import open_db
+    from ..state.state import StateStore
+    from ..store.blockstore import BlockStore
+    cfg = _cfg(args.home)
+    ddir = cfg.path(cfg.base.db_dir)
+    bs = BlockStore(open_db(cfg.base.db_backend, "blockstore", ddir))
+    ss = StateStore(open_db(cfg.base.db_backend, "state", ddir))
+    st = ss.load()
+    out = {"base": bs.base(), "height": bs.height(),
+           "state_height": st.last_block_height if st else None,
+           "app_hash": st.app_hash.hex() if st else None,
+           "validators": len(st.validators) if st else None}
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_compact(args) -> int:
+    """reference commands/compact.go."""
+    from ..db.kv import open_db
+    cfg = _cfg(args.home)
+    ddir = cfg.path(cfg.base.db_dir)
+    for name in ("blockstore", "state", "indexer"):
+        db = open_db(cfg.base.db_backend, name, ddir)
+        compact = getattr(db, "compact", None)
+        if compact is not None:
+            compact()
+        db.close()
+    print("compacted")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cometbft_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, **extra_args):
+        sp = sub.add_parser(name)
+        sp.add_argument("--home", default=os.path.expanduser("~/.cometbft_tpu"))
+        for flag, kw in extra_args.items():
+            sp.add_argument(f"--{flag.replace('_', '-')}", **kw)
+        sp.set_defaults(fn=fn)
+        return sp
+
+    add("init", cmd_init, chain_id={"default": ""})
+    add("start", cmd_start, p2p_laddr={"default": ""},
+        rpc_laddr={"default": ""}, persistent_peers={"default": ""})
+    tn = sub.add_parser("testnet")
+    tn.add_argument("--v", type=int, default=4)
+    tn.add_argument("--o", default="./testnet")
+    tn.add_argument("--chain-id", dest="chain_id", default="tpu-testnet")
+    tn.set_defaults(fn=cmd_testnet)
+    rb = add("rollback", cmd_rollback)
+    rb.add_argument("--hard", action="store_true")
+    add("reset", cmd_reset)
+    add("show-node-id", cmd_show_node_id)
+    add("show-validator", cmd_show_validator)
+    add("inspect", cmd_inspect)
+    add("compact", cmd_compact)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,7 @@ crypto/batch/batch.go:11-35)."""
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 from .keys import BatchVerifier, Ed25519BatchVerifier, PubKey, ED25519_KEY_TYPE
 
@@ -14,9 +14,59 @@ def create_batch_verifier(pk: PubKey) -> Tuple[Optional[BatchVerifier], bool]:
     (reference crypto/batch/batch.go:11-21)."""
     if pk.type_() == ED25519_KEY_TYPE:
         return Ed25519BatchVerifier(), True
+    if pk.type_() == "sr25519":
+        from .sr25519 import Sr25519BatchVerifier
+        return Sr25519BatchVerifier(), True
     return None, False
 
 
 def supports_batch_verifier(pk: PubKey) -> bool:
-    """reference crypto/batch/batch.go:25-35."""
-    return pk is not None and pk.type_() == ED25519_KEY_TYPE
+    """reference crypto/batch/batch.go:25-35 (secp256k1 has no batch
+    form, exactly like the reference — callers fall back to per-sig)."""
+    return pk is not None and pk.type_() in (ED25519_KEY_TYPE, "sr25519")
+
+
+class MixedBatchVerifier:
+    """The BASELINE mixed-curve config: one verifier accepting
+    ed25519 + sr25519 + secp256k1 keys, dispatching each signature to
+    its curve's verifier (batched where the curve supports it, per-sig
+    fallback where it doesn't), with per-signature attribution in the
+    original order."""
+
+    def __init__(self):
+        self._order: List[Tuple[str, int]] = []   # (kind, idx in bucket)
+        self._buckets = {}
+        self._singles: List[Tuple[PubKey, bytes, bytes]] = []
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def add(self, pk: PubKey, msg: bytes, sig: bytes) -> None:
+        kind = pk.type_()
+        bucket = self._buckets.get(kind)
+        if bucket is None and supports_batch_verifier(pk):
+            bucket, _ = create_batch_verifier(pk)
+            self._buckets[kind] = bucket
+        if bucket is not None:
+            self._order.append((kind, len(bucket)))
+            bucket.add(pk, msg, sig)
+        else:
+            self._order.append(("single", len(self._singles)))
+            self._singles.append((pk, msg, sig))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        if not self._order:
+            # match the single-curve verifiers (and the reference):
+            # an empty batch is a failure, not vacuous success
+            return False, []
+        results = {}
+        for kind, bucket in self._buckets.items():
+            _, oks = bucket.verify()
+            results[kind] = oks
+        single_oks = [pk.verify_signature(msg, sig)
+                      for pk, msg, sig in self._singles]
+        out = []
+        for kind, idx in self._order:
+            out.append(single_oks[idx] if kind == "single"
+                       else results[kind][idx])
+        return all(out), out

@@ -1,0 +1,72 @@
+"""Structured leveled logger (reference libs/log/tm_logger.go, lazy.go).
+
+Key-value structured output with module filtering and lazy evaluation —
+callables in kwargs are only invoked if the record is emitted.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, TextIO
+
+DEBUG, INFO, ERROR, NONE = 0, 1, 2, 3
+_NAMES = {DEBUG: "D", INFO: "I", ERROR: "E"}
+
+
+class Logger:
+    def __init__(self, out: Optional[TextIO] = None, level: int = INFO,
+                 module: str = "", module_levels: Optional[Dict[str, int]]
+                 = None, **bound):
+        self._out = out or sys.stderr
+        self._level = level
+        self._module = module
+        self._module_levels = module_levels or {}
+        self._bound = bound
+        self._lock = threading.Lock()
+
+    def with_(self, module: Optional[str] = None, **kv) -> "Logger":
+        """Bind context (reference log.With)."""
+        child = Logger(self._out, self._level,
+                       module if module is not None else self._module,
+                       self._module_levels, **{**self._bound, **kv})
+        child._lock = self._lock
+        return child
+
+    def _enabled(self, level: int) -> bool:
+        threshold = self._module_levels.get(self._module, self._level)
+        return level >= threshold
+
+    def _emit(self, level: int, msg: str, kv: Dict[str, Any]) -> None:
+        if not self._enabled(level):
+            return
+        parts = [f"{_NAMES[level]}[{time.strftime('%H:%M:%S')}]",
+                 msg]
+        if self._module:
+            parts.append(f"module={self._module}")
+        for k, v in {**self._bound, **kv}.items():
+            if callable(v):  # lazy (reference lazy.go)
+                v = v()
+            parts.append(f"{k}={v}")
+        line = " ".join(str(p) for p in parts)
+        with self._lock:
+            self._out.write(line + "\n")
+            self._out.flush()
+
+    def debug(self, msg: str, **kv) -> None:
+        self._emit(DEBUG, msg, kv)
+
+    def info(self, msg: str, **kv) -> None:
+        self._emit(INFO, msg, kv)
+
+    def error(self, msg: str, **kv) -> None:
+        self._emit(ERROR, msg, kv)
+
+
+class NopLogger(Logger):
+    def __init__(self):
+        super().__init__(out=None, level=NONE)
+
+    def _emit(self, level, msg, kv):
+        pass

@@ -1,0 +1,179 @@
+"""Prometheus-style metrics (reference scripts/metricsgen + the
+per-package metrics.go structs, e.g. internal/consensus/metrics.go:34).
+
+Counters, gauges, and histograms with label support, rendered in the
+Prometheus text exposition format. `Registry.expose()` plugs into any
+HTTP handler (config [instrumentation], reference config.go:1378-1384).
+No codegen: Python constructs the struct-of-metrics directly.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return tuple(labels.get(n, "") for n in self.label_names)
+
+    @staticmethod
+    def _fmt_labels(names, values) -> str:
+        if not names:
+            return ""
+        inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+        return "{" + inner + "}"
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_="", label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}"
+                       f"{self._fmt_labels(self.label_names, k)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_="", label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def add(self, amount: float, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}"
+                       f"{self._fmt_labels(self.label_names, k)} {v}")
+        return out
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10)
+
+
+class Histogram(_Metric):
+    """Step-duration histograms double as consensus timing metrics
+    (reference RoundDurationSeconds, BlockProcessingTime)."""
+
+    def __init__(self, name, help_="", label_names=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.buckets) + 1))
+            counts[bisect_right(self.buckets, value)] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for k, counts in sorted(self._counts.items()):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += counts[i]
+                names = self.label_names + ("le",)
+                vals = k + (str(b),)
+                out.append(f"{self.name}_bucket"
+                           f"{self._fmt_labels(names, vals)} {cum}")
+            total = sum(counts)
+            names = self.label_names + ("le",)
+            out.append(f"{self.name}_bucket"
+                       f"{self._fmt_labels(names, k + ('+Inf',))} {total}")
+            out.append(f"{self.name}_sum"
+                       f"{self._fmt_labels(self.label_names, k)} "
+                       f"{self._sums[k]}")
+            out.append(f"{self.name}_count"
+                       f"{self._fmt_labels(self.label_names, k)} {total}")
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "cometbft_tpu"):
+        self.namespace = namespace
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_="", label_names=()) -> Counter:
+        return self._add(Counter(f"{self.namespace}_{name}", help_,
+                                 label_names))
+
+    def gauge(self, name, help_="", label_names=()) -> Gauge:
+        return self._add(Gauge(f"{self.namespace}_{name}", help_,
+                               label_names))
+
+    def histogram(self, name, help_="", label_names=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._add(Histogram(f"{self.namespace}_{name}", help_,
+                                   label_names, buckets))
+
+    def _add(self, m):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class ConsensusMetrics:
+    """The reference's consensus metrics struct
+    (internal/consensus/metrics.go), constructed over a Registry."""
+
+    def __init__(self, reg: Registry):
+        self.height = reg.gauge("consensus_height", "Committed height")
+        self.rounds = reg.counter("consensus_rounds",
+                                  "Rounds entered", ["reason"])
+        self.round_duration = reg.histogram(
+            "consensus_round_duration_seconds",
+            "Time spent per consensus round")
+        self.block_processing = reg.histogram(
+            "consensus_block_processing_seconds",
+            "ApplyBlock wall time")
+        self.validators = reg.gauge("consensus_validators",
+                                    "Validator-set size")
+        self.byzantine_validators = reg.counter(
+            "consensus_byzantine_validators",
+            "Conflicting votes observed")
+        self.sigs_verified = reg.counter(
+            "crypto_sigs_verified", "Signatures verified", ["path"])

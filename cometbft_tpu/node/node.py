@@ -38,6 +38,20 @@ from ..types.proto import Timestamp
 from ..types.validator import Validator
 
 
+def load_or_generate_node_key(path: str) -> Ed25519PrivKey:
+    """Persistent p2p identity key (reference p2p/node_key.go) — the
+    node id must survive restarts or peer allow/ban lists break."""
+    if os.path.exists(path):
+        with open(path) as f:
+            return Ed25519PrivKey(bytes.fromhex(json.load(f)["priv_key"]))
+    key = Ed25519PrivKey.generate()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"priv_key": key.seed.hex(),
+                   "node_id": key.pub_key().address().hex()}, f)
+    return key
+
+
 def save_genesis(gen: GenesisDoc, path: str) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:
@@ -142,7 +156,8 @@ class Node:
         self.consensus.evidence_pool = self.evidence_pool
 
         # --- reactors + switch (node.go:456-494) -----------------------------
-        self.node_key = node_key or Ed25519PrivKey.generate()
+        self.node_key = node_key or load_or_generate_node_key(
+            config.path(config.base.node_key_file))
         self.switch = Switch(self.node_key, self.genesis.chain_id,
                              config.base.moniker)
         self.consensus_reactor = ConsensusReactor(self.consensus)

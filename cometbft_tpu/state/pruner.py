@@ -1,0 +1,54 @@
+"""Background pruning service honoring the app's retain height
+(reference state/pruner.go — the Commit response's retain_height,
+state/execution.go:315).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Pruner:
+    """Prunes block data below the app-requested retain height."""
+
+    def __init__(self, block_store, state_store=None,
+                 interval_s: float = 10.0):
+        self.block_store = block_store
+        self.state_store = state_store
+        self.interval_s = interval_s
+        self._retain = 0
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def set_retain_height(self, height: int) -> None:
+        """Called with ResponseCommit.retain_height (0 = keep all)."""
+        if height > self._retain:
+            self._retain = height
+            self._wake.set()
+
+    def prune_now(self) -> int:
+        retain = self._retain
+        if retain <= 0:
+            return 0
+        pruned = self.block_store.prune_blocks(
+            min(retain, self.block_store.height()))
+        if self.state_store is not None:
+            self.state_store.prune(retain)
+        return pruned
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.is_set():
+                if self._wake.wait(timeout=self.interval_s):
+                    self._wake.clear()
+                if not self._stop.is_set():
+                    self.prune_now()
+        self._thread = threading.Thread(target=loop, name="pruner",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()

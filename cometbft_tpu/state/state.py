@@ -159,6 +159,20 @@ class StateStore:
     def load_finalize_block_response(self, height: int) -> Optional[bytes]:
         return self._db.get(b"abci:" + height.to_bytes(8, "big"))
 
+    def prune(self, retain_height: int) -> int:
+        """Delete ABCI responses and validator sets below retain_height
+        (reference state/store.go PruneStates — the store owns its key
+        layout). Iterates only existing keys, so repeated calls are
+        O(newly-prunable)."""
+        deletes = []
+        for prefix in (b"abci:", b"vals:"):
+            end = prefix + retain_height.to_bytes(8, "big")
+            for k, _v in self._db.iterate(prefix, end):
+                deletes.append(k)
+        if deletes:
+            self._db.write_batch([], deletes)
+        return len(deletes)
+
 
 def _valset_to_json(vs: ValidatorSet) -> bytes:
     prop = vs.get_proposer()

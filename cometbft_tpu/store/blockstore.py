@@ -113,6 +113,25 @@ class BlockStore:
         raw = self._db.get(_h(b"SC:", height))
         return Commit.decode(raw) if raw is not None else None
 
+    def delete_block(self, height: int) -> None:
+        """Remove the TIP block (reference store/store.go
+        DeleteLatestBlock — the rollback repair path)."""
+        with self._lock:
+            if height != self._height:
+                raise ValueError(
+                    f"can only delete the tip ({self._height}), "
+                    f"got {height}")
+            meta = self.load_block_meta(height)
+            deletes = [_h(b"H:", height), _h(b"C:", height),
+                       _h(b"SC:", height)]
+            if meta:
+                for i in range(meta[0].parts.total):
+                    deletes.append(_h(b"P:", height)
+                                   + i.to_bytes(4, "big"))
+            self._height = height - 1
+            self._db.write_batch(
+                [(_KEY_HEIGHT, self._height.to_bytes(8, "big"))], deletes)
+
     def prune_blocks(self, retain_height: int) -> int:
         """Delete blocks below retain_height; returns pruned count
         (reference store/store.go PruneBlocks)."""

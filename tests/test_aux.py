@@ -1,0 +1,128 @@
+"""Aux subsystems: metrics, structured logging, rollback, pruner, CLI
+(reference scripts/metricsgen outputs, libs/log, state/rollback.go,
+state/pruner.go, cmd/cometbft/commands)."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cometbft_tpu.libs.log import DEBUG, INFO, Logger
+from cometbft_tpu.libs.metrics import (ConsensusMetrics, Registry)
+
+
+def test_metrics_counter_gauge_histogram():
+    reg = Registry("test")
+    c = reg.counter("ops_total", "ops", ["kind"])
+    g = reg.gauge("height", "h")
+    h = reg.histogram("latency_seconds", "lat", buckets=(0.1, 1.0))
+    c.inc(kind="read")
+    c.inc(2, kind="write")
+    g.set(42)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    assert 'test_ops_total{kind="read"} 1.0' in text
+    assert 'test_ops_total{kind="write"} 2.0' in text
+    assert "test_height 42.0" in text
+    assert 'test_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_latency_seconds_bucket{le="1.0"} 2' in text
+    assert 'test_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "test_latency_seconds_count 3" in text
+    # the consensus struct constructs cleanly
+    ConsensusMetrics(Registry())
+
+
+def test_logger_levels_modules_lazy():
+    buf = io.StringIO()
+    log = Logger(out=buf, level=INFO,
+                 module_levels={"p2p": DEBUG})
+    called = []
+    log.debug("hidden", expensive=lambda: called.append(1) or "x")
+    assert not called  # lazy arg never evaluated below threshold
+    log.info("visible", height=5)
+    p2p = log.with_(module="p2p", peer="abc")
+    p2p.debug("gossip", ch=0x22)
+    out = buf.getvalue()
+    assert "hidden" not in out
+    assert "visible" in out and "height=5" in out
+    assert "gossip" in out and "module=p2p" in out and "peer=abc" in out
+
+
+def _executed_store(n=5):
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.db.kv import MemDB
+    from cometbft_tpu.engine.chain_gen import generate_chain
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State, StateStore
+    from cometbft_tpu.store.blockstore import BlockStore
+    chain = generate_chain(n, n_validators=4, txs_per_block=1)
+    app = KVStoreApplication()
+    app.init_chain(chain.chain_id, 1, [], b"")
+    bs, ss = BlockStore(MemDB()), StateStore(MemDB())
+    ex = BlockExecutor(app, state_store=ss, block_store=bs)
+    st = State.from_genesis(chain.genesis)
+    ss.save(st)
+    for h in range(1, n + 1):
+        bs.save_block(chain.blocks[h - 1],
+                      chain.blocks[h - 1].make_part_set(),
+                      chain.seen_commits[h - 1])
+        st, _ = ex.apply_block(st, chain.block_ids[h - 1],
+                               chain.blocks[h - 1], verified=True)
+    return chain, bs, ss, st
+
+
+def test_rollback_one_height():
+    from cometbft_tpu.state.rollback import rollback_state
+    chain, bs, ss, st = _executed_store(5)
+    assert st.last_block_height == 5
+    new_state = rollback_state(ss, bs, remove_block=True)
+    assert new_state.last_block_height == 4
+    assert bs.height() == 4
+    # rolled-back state matches what header 5 committed to
+    hdr5 = chain.blocks[4].header
+    assert new_state.app_hash == hdr5.app_hash
+    assert new_state.validators.hash() == hdr5.validators_hash
+    assert ss.load().last_block_height == 4
+
+
+def test_pruner_honors_retain_height():
+    from cometbft_tpu.state.pruner import Pruner
+    _chain, bs, ss, _st = _executed_store(5)
+    p = Pruner(bs, ss)
+    p.set_retain_height(4)
+    pruned = p.prune_now()
+    assert pruned == 3
+    assert bs.base() == 4
+    assert bs.load_block(2) is None
+    assert bs.load_block(5) is not None
+
+
+def test_cli_init_testnet_show(tmp_path):
+    from cometbft_tpu.cmd.main import main
+    home = str(tmp_path / "home")
+    assert main(["init", "--home", home, "--chain-id", "cli-chain"]) == 0
+    assert os.path.exists(os.path.join(home, "config/config.toml"))
+    assert os.path.exists(os.path.join(home, "config/genesis.json"))
+    assert os.path.exists(os.path.join(home, "config/priv_validator.json"))
+    # idempotent
+    assert main(["init", "--home", home]) == 0
+
+    out = str(tmp_path / "net")
+    assert main(["testnet", "--v", "3", "--o", out]) == 0
+    genesis_files = [json.load(open(os.path.join(out, f"node{i}",
+                                                 "config/genesis.json")))
+                     for i in range(3)]
+    assert genesis_files[0] == genesis_files[1] == genesis_files[2]
+    assert len(genesis_files[0]["validators"]) == 3
+
+    import contextlib, io as _io
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["show-validator", "--home", home]) == 0
+    v = json.loads(buf.getvalue())
+    assert v["type"] == "ed25519" and len(bytes.fromhex(v["value"])) == 32

@@ -1,0 +1,177 @@
+"""secp256k1 + sr25519 + mixed-curve batch dispatch
+(reference crypto/secp256k1/secp256k1_test.go, crypto/sr25519/,
+crypto/batch — the BASELINE mixed-curve config).
+
+sr25519 NOTE: the implementation is structurally schnorrkel
+(merlin/STROBE transcripts over ristretto255) and fully self-consistent
+(sign/verify/batch round-trip, tamper rejection below), but
+cross-implementation byte-compat vectors are unpinnable in this
+environment (no schnorrkel build, no network). Pin vectors before
+substrate interop.
+"""
+
+import random
+
+import pytest
+
+from cometbft_tpu.crypto.batch import (MixedBatchVerifier,
+                                       create_batch_verifier,
+                                       supports_batch_verifier)
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.crypto.secp256k1 import (
+    N, Secp256k1PrivKey, Secp256k1PubKey, _decompress, _pt_mul, GX, GY)
+from cometbft_tpu.crypto.sr25519 import (
+    Sr25519BatchVerifier, Sr25519PrivKey, Sr25519PubKey, Transcript,
+    keccak_f1600, ristretto_decode, ristretto_encode)
+
+RNG = random.Random(31)
+
+
+# --- secp256k1 ---------------------------------------------------------------
+
+def test_secp256k1_sign_verify_roundtrip():
+    k = Secp256k1PrivKey.generate(RNG)
+    pub = k.pub_key()
+    msg = b"secp256k1 message"
+    sig = k.sign(msg)
+    assert len(sig) == 64
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(msg + b"x", sig)
+    assert not pub.verify_signature(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    # deterministic (RFC 6979)
+    assert k.sign(msg) == sig
+    # low-s enforced: the complementary high-s signature must be rejected
+    r = sig[:32]
+    s = int.from_bytes(sig[32:], "big")
+    high_s = (N - s).to_bytes(32, "big")
+    assert not pub.verify_signature(msg, r + high_s)
+
+
+def test_secp256k1_known_point():
+    # 2*G, a SEC2-derivable constant
+    two_g = _pt_mul(2, (GX, GY))
+    assert two_g[0] == int(
+        "C6047F9441ED7D6D3045406E95C07CD85C778E4B8CEF3CA7ABAC09B95C709EE5",
+        16)
+    # compress/decompress roundtrip
+    pk = Secp256k1PrivKey.generate(RNG).pub_key()
+    assert _decompress(pk.raw) is not None
+
+
+def test_secp256k1_address_format():
+    pk = Secp256k1PrivKey.generate(RNG).pub_key()
+    assert len(pk.address()) == 20
+    assert pk.type_() == "secp256k1"
+
+
+# --- sr25519 primitives ------------------------------------------------------
+
+def test_keccak_f1600_changes_state_deterministically():
+    s1, s2 = bytearray(200), bytearray(200)
+    keccak_f1600(s1)
+    keccak_f1600(s2)
+    assert s1 == s2 and s1 != bytearray(200)
+    # theta/chi nonlinearity: different input, different output
+    s3 = bytearray(200)
+    s3[0] = 1
+    keccak_f1600(s3)
+    assert s3 != s1
+
+
+def test_merlin_transcript_determinism_and_binding():
+    def challenge(msgs):
+        t = Transcript(b"test")
+        for label, m in msgs:
+            t.append_message(label, m)
+        return t.challenge_bytes(b"c", 32)
+
+    base = [(b"a", b"1"), (b"b", b"2")]
+    assert challenge(base) == challenge(base)
+    assert challenge(base) != challenge([(b"a", b"1"), (b"b", b"3")])
+    assert challenge(base) != challenge([(b"a", b"12"), (b"b", b"")])
+    # framing: label/message splits must not collide
+    assert challenge([(b"ab", b"c")]) != challenge([(b"a", b"bc")])
+
+
+def test_ristretto_roundtrip_and_canonicality():
+    from cometbft_tpu.crypto import ref_ed25519 as ed
+    for mult in (1, 2, 7, 12345,
+                 RNG.randrange(1, ed.L), RNG.randrange(1, ed.L)):
+        pt = ed.pt_mul(mult, ed.BASE)
+        enc = ristretto_encode(pt)
+        dec = ristretto_decode(enc)
+        assert dec is not None
+        assert ristretto_encode(dec) == enc
+    # torsion invariance: P and P+T encode identically for 2-torsion T
+    pt = ed.pt_mul(9, ed.BASE)
+    torsion = (0, ed.P - 1, 1, 0)  # the order-2 point (0, -1)
+    pt_plus_t = ed.pt_add(pt, torsion)
+    assert ristretto_encode(pt) == ristretto_encode(pt_plus_t)
+    # non-canonical encodings rejected
+    assert ristretto_decode(b"\xff" * 32) is None
+    assert ristretto_decode((1).to_bytes(32, "little")) is None  # odd
+
+
+def test_sr25519_sign_verify_roundtrip():
+    k = Sr25519PrivKey.generate(RNG)
+    pub = k.pub_key()
+    msg = b"sr25519 message"
+    sig = k.sign(msg)
+    assert len(sig) == 64 and sig[63] & 0x80
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(msg + b"!", sig)
+    assert not pub.verify_signature(msg, bytes(64))
+    corrupted = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+    assert not pub.verify_signature(msg, corrupted)
+    # context binding
+    assert not pub.verify_signature(msg, sig, context=b"other-ctx")
+    # wrong key
+    assert not Sr25519PrivKey.generate(RNG).pub_key().verify_signature(
+        msg, sig)
+
+
+def test_sr25519_batch_verifier():
+    items = []
+    for i in range(6):
+        k = Sr25519PrivKey.generate(RNG)
+        m = bytes([i]) * 20
+        items.append((k.pub_key(), m, k.sign(m)))
+    bv = Sr25519BatchVerifier()
+    for pk, m, s in items:
+        bv.add(pk, m, s)
+    ok, oks = bv.verify()
+    assert ok and all(oks)
+    # one corrupted -> batch fails, attribution points at it
+    bv2 = Sr25519BatchVerifier()
+    for i, (pk, m, s) in enumerate(items):
+        bv2.add(pk, m, bytes(63) + b"\x80" if i == 3 else s)
+    ok, oks = bv2.verify()
+    assert not ok
+    assert oks == [True, True, True, False, True, True]
+
+
+# --- mixed-curve dispatch (BASELINE config) ----------------------------------
+
+def test_mixed_curve_batch_dispatch():
+    eds = [Ed25519PrivKey.generate(RNG) for _ in range(3)]
+    srs = [Sr25519PrivKey.generate(RNG) for _ in range(2)]
+    secps = [Secp256k1PrivKey.generate(RNG) for _ in range(2)]
+
+    assert supports_batch_verifier(eds[0].pub_key())
+    assert supports_batch_verifier(srs[0].pub_key())
+    assert not supports_batch_verifier(secps[0].pub_key())
+    assert create_batch_verifier(secps[0].pub_key()) == (None, False)
+
+    mixed = MixedBatchVerifier()
+    expect = []
+    for i, k in enumerate([eds[0], srs[0], secps[0], eds[1], secps[1],
+                           srs[1], eds[2]]):
+        m = f"mixed-{i}".encode()
+        sig = k.sign(m)
+        if i == 4:  # corrupt the second secp sig
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        mixed.add(k.pub_key(), m, sig)
+        expect.append(i != 4)
+    ok, oks = mixed.verify()
+    assert not ok
+    assert oks == expect
