@@ -81,13 +81,17 @@ def cmd_start(args) -> int:
 
 def cmd_testnet(args) -> int:
     """reference commands/testnet.go: write N validator homes sharing a
-    genesis."""
+    genesis, with deterministic ports and a full persistent-peer mesh —
+    the homes must form a network when started as-is."""
     from ..config import Config
     from ..privval.file import FilePV
     from ..node.node import save_genesis
     from ..state.state import GenesisDoc
     from ..types.validator import Validator
     n = args.v
+    base_port = args.base_port
+    p2p_ports = [base_port + 2 * i for i in range(n)]
+    rpc_ports = [base_port + 2 * i + 1 for i in range(n)]
     pvs, vals = [], []
     for i in range(n):
         home = os.path.join(args.o, f"node{i}")
@@ -96,6 +100,10 @@ def cmd_testnet(args) -> int:
         cfg = Config(root_dir=home)
         cfg.base.chain_id = args.chain_id
         cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"127.0.0.1:{p2p_ports[i]}"
+        cfg.rpc.laddr = f"127.0.0.1:{rpc_ports[i]}"
+        cfg.p2p.persistent_peers = ",".join(
+            f"127.0.0.1:{p}" for j, p in enumerate(p2p_ports) if j != i)
         cfg.write()
         pv = FilePV.load_or_generate(
             cfg.path(cfg.base.priv_validator_file))
@@ -107,7 +115,8 @@ def cmd_testnet(args) -> int:
     for i in range(n):
         save_genesis(gen, os.path.join(args.o, f"node{i}",
                                        "config/genesis.json"))
-    print(f"wrote {n} node homes under {args.o}")
+    print(f"wrote {n} node homes under {args.o} "
+          f"(p2p ports {p2p_ports[0]}..{p2p_ports[-1]})")
     return 0
 
 
@@ -212,6 +221,8 @@ def build_parser() -> argparse.ArgumentParser:
     tn.add_argument("--v", type=int, default=4)
     tn.add_argument("--o", default="./testnet")
     tn.add_argument("--chain-id", dest="chain_id", default="tpu-testnet")
+    tn.add_argument("--base-port", dest="base_port", type=int,
+                    default=26656)
     tn.set_defaults(fn=cmd_testnet)
     rb = add("rollback", cmd_rollback)
     rb.add_argument("--hard", action="store_true")

@@ -607,14 +607,18 @@ class ConsensusState:
         bid = BlockID(block.hash(), parts.header)
         seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
 
+        from ..libs.fail import fail_point
+        fail_point("finalize:pre-save")              # state.go:1857
         if self.block_store is not None and \
                 self.block_store.height() < height:
             self.block_store.save_block(block, parts, seen_commit)
+        fail_point("finalize:post-save")             # state.go:1874
 
         # the WAL must know the height is decided before the app mutates
         # (reference state.go:1890 WriteSync EndHeightMessage)
         if not self._replaying:
             self.wal.write_sync(EndHeightMessage(height))
+        fail_point("finalize:post-endheight")        # state.go:1897
 
         new_state, _resp = self.executor.apply_block(
             self.state, bid, block, verified=True)

@@ -1,0 +1,84 @@
+"""ASCII-armored, passphrase-encrypted private keys
+(reference crypto/armor/armor.go, crypto/xsalsa20symmetric — the
+`export/import` key codec; AEAD here is ChaCha20-Poly1305 with an
+scrypt-style KDF replaced by PBKDF2-HMAC-SHA256, both stdlib-backed).
+
+Format:
+  -----BEGIN COMETBFT_TPU PRIVATE KEY-----
+  kdf: pbkdf2-sha256
+  salt: <hex>
+  type: <key type>
+  <base64 of nonce || AEAD ciphertext>
+  -----END COMETBFT_TPU PRIVATE KEY-----
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+from typing import Tuple
+
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+_HEADER = "-----BEGIN COMETBFT_TPU PRIVATE KEY-----"
+_FOOTER = "-----END COMETBFT_TPU PRIVATE KEY-----"
+_KDF_ROUNDS = 100_000
+
+
+class ArmorError(Exception):
+    pass
+
+
+def _derive(passphrase: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", passphrase.encode(), salt,
+                               _KDF_ROUNDS, dklen=32)
+
+
+def encrypt_armor_privkey(key_bytes: bytes, key_type: str,
+                          passphrase: str) -> str:
+    salt = os.urandom(16)
+    nonce = os.urandom(12)
+    aead = ChaCha20Poly1305(_derive(passphrase, salt))
+    sealed = aead.encrypt(nonce, key_bytes, key_type.encode())
+    body = base64.b64encode(nonce + sealed).decode()
+    return "\n".join([
+        _HEADER,
+        "kdf: pbkdf2-sha256",
+        f"salt: {salt.hex()}",
+        f"type: {key_type}",
+        "",
+        body,
+        _FOOTER,
+    ])
+
+
+def unarmor_decrypt_privkey(armored: str, passphrase: str
+                            ) -> Tuple[bytes, str]:
+    """-> (key bytes, key type). Raises ArmorError on bad format or
+    wrong passphrase."""
+    lines = [ln.strip() for ln in armored.strip().splitlines()]
+    if not lines or lines[0] != _HEADER or lines[-1] != _FOOTER:
+        raise ArmorError("missing armor header/footer")
+    headers = {}
+    body_lines = []
+    for ln in lines[1:-1]:
+        if ":" in ln and not body_lines and ln:
+            k, _, v = ln.partition(":")
+            headers[k.strip()] = v.strip()
+        elif ln:
+            body_lines.append(ln)
+    if headers.get("kdf") != "pbkdf2-sha256":
+        raise ArmorError(f"unsupported kdf {headers.get('kdf')!r}")
+    try:
+        salt = bytes.fromhex(headers["salt"])
+        blob = base64.b64decode("".join(body_lines))
+    except (KeyError, ValueError) as e:
+        raise ArmorError(f"malformed armor: {e}") from e
+    key_type = headers.get("type", "")
+    aead = ChaCha20Poly1305(_derive(passphrase, salt))
+    try:
+        plain = aead.decrypt(blob[:12], blob[12:], key_type.encode())
+    except Exception as e:
+        raise ArmorError("wrong passphrase or corrupted armor") from e
+    return plain, key_type

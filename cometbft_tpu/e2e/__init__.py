@@ -1,0 +1,3 @@
+from .runner import Testnet, Manifest
+
+__all__ = ["Testnet", "Manifest"]

@@ -1,0 +1,183 @@
+"""E2E testnet runner: TOML manifest → real OS node processes → RPC
+invariant checks, with kill/restart perturbations
+(reference test/e2e/pkg/manifest.go, runner/{setup,start,perturb}.go —
+Docker Compose replaced by local subprocesses; same black-box shape).
+
+Manifest:
+    [testnet]
+    chain_id = "e2e-net"
+    validators = 4
+
+    [node.extra0]          # optional non-validator full nodes
+    ...
+
+Each node runs `python -m cometbft_tpu.cmd.main start` in its own
+process with its own home dir, talking real TCP p2p + RPC.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional
+
+from ..rpc.client import RPCClient
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-net"
+    validators: int = 4
+    timeout_commit_ms: int = 50
+
+    @classmethod
+    def from_toml(cls, text: str) -> "Manifest":
+        import tomllib
+        d = tomllib.loads(text).get("testnet", {})
+        return cls(chain_id=d.get("chain_id", "e2e-net"),
+                   validators=int(d.get("validators", 4)),
+                   timeout_commit_ms=int(d.get("timeout_commit_ms", 50)))
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@dataclass
+class NodeProc:
+    name: str
+    home: str
+    p2p_port: int
+    rpc_port: int
+    proc: Optional[subprocess.Popen] = None
+    log_path: str = ""
+
+    def rpc(self) -> RPCClient:
+        return RPCClient("127.0.0.1", self.rpc_port, timeout=10)
+
+
+class Testnet:
+    """reference test/e2e/runner — setup, start, perturb, test."""
+
+    __test__ = False  # not a pytest collection target
+
+    def __init__(self, manifest: Manifest, root: str):
+        self.manifest = manifest
+        self.root = root
+        self.nodes: List[NodeProc] = []
+
+    # --- setup (runner/setup.go) ---------------------------------------------
+
+    def setup(self) -> None:
+        from ..cmd.main import main as cli
+        n = self.manifest.validators
+        rc = cli(["testnet", "--v", str(n), "--o", self.root,
+                  "--chain-id", self.manifest.chain_id])
+        assert rc == 0
+        ports = _free_ports(2 * n)
+        for i in range(n):
+            home = os.path.join(self.root, f"node{i}")
+            node = NodeProc(name=f"node{i}", home=home,
+                            p2p_port=ports[2 * i],
+                            rpc_port=ports[2 * i + 1],
+                            log_path=os.path.join(home, "node.log"))
+            self.nodes.append(node)
+        # rewrite configs: fixed ports, full persistent-peer mesh, fast
+        # consensus timeouts
+        from ..config import Config
+        for i, node in enumerate(self.nodes):
+            cfg = Config.load(node.home)
+            cfg.p2p.laddr = f"127.0.0.1:{node.p2p_port}"
+            cfg.rpc.laddr = f"127.0.0.1:{node.rpc_port}"
+            cfg.p2p.persistent_peers = ",".join(
+                f"127.0.0.1:{o.p2p_port}"
+                for j, o in enumerate(self.nodes) if j != i)
+            tc = self.manifest.timeout_commit_ms
+            cfg.consensus.timeout_commit = tc
+            cfg.consensus.timeout_propose = max(500, tc * 10)
+            cfg.consensus.timeout_propose_delta = 250
+            cfg.consensus.timeout_prevote = max(250, tc * 5)
+            cfg.consensus.timeout_precommit = max(250, tc * 5)
+            cfg.write()
+
+    # --- lifecycle (runner/start.go) -----------------------------------------
+
+    def start_node(self, node: NodeProc) -> None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        log = open(node.log_path, "ab")
+        node.proc = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu.cmd.main", "start",
+             "--home", node.home],
+            stdout=log, stderr=log, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+
+    def start(self) -> None:
+        for node in self.nodes:
+            self.start_node(node)
+
+    def kill_node(self, node: NodeProc, hard: bool = True) -> None:
+        """runner/perturb.go: kill (SIGKILL) or graceful stop."""
+        if node.proc is None:
+            return
+        node.proc.send_signal(
+            signal.SIGKILL if hard else signal.SIGTERM)
+        node.proc.wait(timeout=30)
+        node.proc = None
+
+    def stop(self) -> None:
+        for node in self.nodes:
+            try:
+                self.kill_node(node)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # --- checks (runner/test.go-ish invariants over RPC) ---------------------
+
+    def wait_for_height(self, height: int, timeout: float = 120.0,
+                        nodes: Optional[List[NodeProc]] = None) -> None:
+        deadline = time.monotonic() + timeout
+        pending = list(nodes if nodes is not None else self.nodes)
+        while pending and time.monotonic() < deadline:
+            still = []
+            for node in pending:
+                try:
+                    h = node.rpc().status()["sync_info"][
+                        "latest_block_height"]
+                    if h < height:
+                        still.append(node)
+                except Exception:  # noqa: BLE001 — not up yet
+                    still.append(node)
+            pending = still
+            if pending:
+                time.sleep(0.25)
+        if pending:
+            raise TimeoutError(
+                f"nodes never reached {height}: "
+                f"{[n.name for n in pending]}")
+
+    def check_no_fork(self, upto: int) -> None:
+        """Every node reports identical block hashes (the core e2e
+        invariant, test/e2e/tests/block_test.go)."""
+        for h in range(1, upto + 1):
+            hashes = set()
+            for node in self.nodes:
+                if node.proc is None:
+                    continue
+                blk = node.rpc().block(h)
+                hashes.add(blk["block_id"]["hash"])
+            assert len(hashes) == 1, f"fork at height {h}: {hashes}"

@@ -66,8 +66,6 @@ class TiledCommitVerifier:
         valid, and the for-block voting power must exceed 2/3. Full
         semantics here is what lets the apply path skip per-commit
         re-verification entirely."""
-        from ..ops.ed25519 import verify_batch
-
         pubs: List[bytes] = []
         msgs: List[bytes] = []
         sigs: List[bytes] = []
@@ -75,10 +73,19 @@ class TiledCommitVerifier:
         for e in entries:
             metas.append(self._add_commit(e, pubs, msgs, sigs))
 
-        if pubs:
-            out = verify_batch(pubs, msgs, sigs, batch_size=self.batch_size)
-        else:
+        from ..types.validation import BATCH_VERIFY_THRESHOLD
+        if not pubs:
             out = np.zeros((0,), dtype=bool)
+        elif len(pubs) < BATCH_VERIFY_THRESHOLD:
+            # small tiles (boot catch-up over a few heights): the native
+            # single-sig path beats a device dispatch + cold compile
+            from ..crypto.keys import Ed25519PubKey
+            out = np.array([
+                len(p) == 32 and Ed25519PubKey(p).verify_signature(m, s)
+                for p, m, s in zip(pubs, msgs, sigs)], dtype=bool)
+        else:
+            from ..ops.ed25519 import verify_batch
+            out = verify_batch(pubs, msgs, sigs, batch_size=self.batch_size)
 
         for e, rows, needed in metas:
             if rows is None:  # structural failure already decided

@@ -130,9 +130,14 @@ class BlocksyncNetReactor:
         for p in peers:
             p.try_send(BLOCKSYNC_CHANNEL, _msg(_STATUS_REQ))
 
-    def max_peer_height(self) -> int:
+    def max_peer_height(self):
+        """Max height any peer reported, or None when no peer has
+        answered a status request yet (0 is a real answer: a fresh
+        chain)."""
         with self._lock:
-            return max(self._peer_status.values(), default=0)
+            if not self._peer_status:
+                return None
+            return max(self._peer_status.values())
 
     def request_block(self, height: int, timeout: float = 20.0
                       ) -> Optional[Tuple[Block, str]]:
@@ -171,7 +176,7 @@ class NetSource:
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline:
             h = self.reactor.max_peer_height()
-            if h:
+            if h is not None:  # 0 is a real answer (fresh chain)
                 return h
             time.sleep(0.05)
         return 0

@@ -1,0 +1,40 @@
+"""Crash-injection points (reference internal/fail/fail.go:47 — the
+FAIL_TEST_INDEX mechanism sprinkled through the commit path,
+state/execution.go:262-312, consensus state.go:1857-1897).
+
+Set COMETBFT_TPU_FAIL_INDEX=N (or call set_fail_index) and the Nth
+`fail_point()` crossed in the process exits hard — exercising every
+crash-recovery class (WAL replay, handshake replay, torn files) without
+hand-placed kill timing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_counter = 0
+_target = int(os.environ.get("COMETBFT_TPU_FAIL_INDEX", "-1"))
+
+
+def set_fail_index(n: int) -> None:
+    global _target, _counter
+    with _lock:
+        _target = n
+        _counter = 0
+
+
+def fail_point(label: str = "") -> None:
+    """Crash (os._exit, no cleanup — like a power cut) when this is the
+    configured failure index."""
+    global _counter
+    if _target < 0:
+        return
+    with _lock:
+        hit = _counter == _target
+        _counter += 1
+    if hit:
+        import sys
+        print(f"FAIL_POINT hit: {label}", file=sys.stderr, flush=True)
+        os._exit(99)

@@ -230,7 +230,62 @@ class Node:
                 self.switch.dial(ph, int(pp))
             except OSError:
                 pass  # reference retries via ensurePeers; peers also dial us
+        if self.config.base.block_sync:
+            # blocksync to the peer tip BEFORE consensus (the reference's
+            # blocksync mode → switchToConsensus,
+            # internal/blocksync/reactor.go:388); consensus messages
+            # arriving meanwhile queue in the inbox and replay on start
+            threading.Thread(target=self._sync_then_consensus,
+                             name="blocksync-boot", daemon=True).start()
+        else:
+            self.consensus.start()
+
+    def _sync_then_consensus(self) -> None:
+        from ..engine.blocksync import (BlocksyncReactor, SyncStalled)
+        from ..engine.pool import PooledSource
+        from ..state.execution import BlockValidationError
+        src = NetSource(self.blocksync_reactor, self.switch)
+        state = self.consensus.state
+        # catch up until no peer is ahead (each pass re-queries peer
+        # status; a fresh net reports height 0 and falls through fast)
+        for _round in range(100):
+            target = src.max_height()
+            if target <= state.last_block_height:
+                break
+            pooled = PooledSource(src, state.last_block_height + 1,
+                                  lookahead=32, n_workers=4)
+            engine = BlocksyncReactor(
+                self.executor, self.block_store, pooled,
+                self.genesis.chain_id, tile_size=16, batch_size=256)
+            try:
+                state = engine.sync(state, target)
+            except (BlockValidationError, SyncStalled):
+                # peers can't serve clean blocks right now; consensus
+                # gossip takes over from wherever sync actually got to
+                state = self._recover_sync_state(state)
+                break
+            except Exception:  # noqa: BLE001 — never boot-loop silently
+                import traceback
+                traceback.print_exc()
+                state = self._recover_sync_state(state)
+                break
+            finally:
+                pooled.stop()
+        if state is not self.consensus.state:
+            self.consensus.state = state
+            self.consensus._update_to_state(state)
         self.consensus.start()
+
+    def _recover_sync_state(self, fallback):
+        """Blocksync applies tile-by-tile through the executor (which
+        persists after each block), so on failure the authoritative
+        partially-advanced state lives in the state store — reusing the
+        pre-sync snapshot would re-execute blocks the app already saw."""
+        stored = self.state_store.load()
+        if stored is not None and \
+                stored.last_block_height > fallback.last_block_height:
+            return stored
+        return fallback
 
     def stop(self) -> None:
         self.consensus.stop()

@@ -148,6 +148,8 @@ class BlockExecutor:
         if not verified:
             validate_block(state, block)
 
+        from ..libs.fail import fail_point
+        fail_point("apply_block:pre-finalize")       # execution.go:262
         resp = self.app.finalize_block(RequestFinalizeBlock(
             txs=block.data.txs,
             height=block.header.height,
@@ -161,10 +163,12 @@ class BlockExecutor:
                 "app returned wrong number of tx results")
 
         new_state = self._update_state(state, block_id, block, resp)
+        fail_point("apply_block:post-finalize")      # execution.go:269
 
         if self.state_store is not None:
             self.state_store.save_finalize_block_response(
                 block.header.height, resp.encode())
+        fail_point("apply_block:post-save-response")  # execution.go:304
 
         # app commit + mempool update (reference execution.go:296,390)
         if self.mempool is not None:

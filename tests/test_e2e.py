@@ -1,0 +1,55 @@
+"""E2E: 4 validator nodes as REAL OS processes over TCP, committing
+blocks, surviving a kill+restart perturbation, serving txs — the
+test/e2e ci-manifest shape (reference test/e2e/networks/ci.toml,
+runner/perturb.go, tests/block_test.go)."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.e2e.runner import Manifest, Testnet
+
+MANIFEST = """
+[testnet]
+chain_id = "e2e-ci"
+validators = 4
+timeout_commit_ms = 50
+"""
+
+
+@pytest.mark.slow
+def test_e2e_processes_commit_perturb_recover(tmp_path):
+    net = Testnet(Manifest.from_toml(MANIFEST), str(tmp_path / "net"))
+    net.setup()
+    net.start()
+    try:
+        net.wait_for_height(3, timeout=180)
+        net.check_no_fork(2)
+
+        # tx through node 2's RPC, visible via node 0's app
+        r = net.nodes[2].rpc().broadcast_tx_sync(b"e2e=proc")
+        assert r["code"] == 0
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            q = net.nodes[0].rpc().abci_query("/store", b"e2e")
+            if bytes.fromhex(q["value"]) == b"proc":
+                break
+            time.sleep(0.25)
+        else:
+            raise TimeoutError("tx never executed across processes")
+
+        # perturbation: SIGKILL node 3, the rest keep committing
+        victim = net.nodes[3]
+        h_before = victim.rpc().status()["sync_info"][
+            "latest_block_height"]
+        net.kill_node(victim, hard=True)
+        survivors = net.nodes[:3]
+        target = h_before + 3
+        net.wait_for_height(target, timeout=180, nodes=survivors)
+
+        # restart: the killed node replays its WAL and catches up
+        net.start_node(victim)
+        net.wait_for_height(target, timeout=180, nodes=[victim])
+        net.check_no_fork(2)
+    finally:
+        net.stop()
