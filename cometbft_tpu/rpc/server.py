@@ -280,6 +280,11 @@ class RPCServer:
                 "wait_event")}
 
         class Handler(BaseHTTPRequestHandler):
+            # RFC 6455 requires the 101 on HTTP/1.1 (clients reject a
+            # 1.0 status line); every JSON response sets Content-Length
+            # so 1.1 keep-alive is safe
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args):  # silence
                 pass
 
@@ -322,6 +327,14 @@ class RPCServer:
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 method = parsed.path.strip("/")
+                if method == "websocket":
+                    from .websocket import (is_websocket_upgrade,
+                                            serve_websocket)
+                    if is_websocket_upgrade(self.headers) and \
+                            env.event_bus is not None:
+                        serve_websocket(self, env.event_bus)
+                        self.close_connection = True
+                        return
                 params = {k: v[0] for k, v in
                           urllib.parse.parse_qs(parsed.query).items()}
                 self._run(method or "health", params, -1)

@@ -23,7 +23,8 @@ def test_e2e_processes_commit_perturb_recover(tmp_path):
     net.setup()
     net.start()
     try:
-        net.wait_for_height(3, timeout=180)
+        # generous: one-core box, 4 node processes + pytest contend
+        net.wait_for_height(3, timeout=300)
         net.check_no_fork(2)
 
         # tx through node 2's RPC, visible via node 0's app
@@ -45,11 +46,11 @@ def test_e2e_processes_commit_perturb_recover(tmp_path):
         net.kill_node(victim, hard=True)
         survivors = net.nodes[:3]
         target = h_before + 3
-        net.wait_for_height(target, timeout=180, nodes=survivors)
+        net.wait_for_height(target, timeout=300, nodes=survivors)
 
         # restart: the killed node replays its WAL and catches up
         net.start_node(victim)
-        net.wait_for_height(target, timeout=180, nodes=[victim])
+        net.wait_for_height(target, timeout=300, nodes=[victim])
         net.check_no_fork(2)
     finally:
         net.stop()

@@ -90,7 +90,9 @@ def test_four_node_network_commits_and_serves_rpc(tmp_path):
                     except OSError:
                         pass
 
-        deadline = time.monotonic() + 120
+        # generous: the CI box has one core and sibling suites may be
+        # compiling kernels concurrently
+        deadline = time.monotonic() + 300
         while time.monotonic() < deadline:
             if all(nd.consensus.state.last_block_height >= 2
                    for nd in nodes):

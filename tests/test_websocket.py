@@ -1,0 +1,103 @@
+"""WebSocket event subscriptions over the RPC server (reference
+rpc/jsonrpc/server/ws_handler_test.go shape, raw-socket client)."""
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+
+from cometbft_tpu.pubsub.events import EventBus
+from cometbft_tpu.rpc.server import RPCEnvironment, RPCServer
+
+
+def _ws_connect(host, port):
+    s = socket.create_connection((host, port), timeout=10)
+    key = base64.b64encode(os.urandom(16)).decode()
+    s.sendall((f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+               f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+               f"Sec-WebSocket-Key: {key}\r\n"
+               f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += s.recv(4096)
+    head = resp.split(b"\r\n\r\n", 1)[0].decode()
+    assert "101" in head.splitlines()[0], head
+    expect = base64.b64encode(hashlib.sha1(
+        (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode())
+        .digest()).decode()
+    assert f"Sec-WebSocket-Accept: {expect}" in head
+    return s, resp.split(b"\r\n\r\n", 1)[1]
+
+
+def _send_text(s, payload: dict):
+    data = json.dumps(payload).encode()
+    mask = os.urandom(4)
+    masked = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+    assert len(data) < 126
+    s.sendall(bytes([0x81, 0x80 | len(data)]) + mask + masked)
+
+
+def _read_frame(s, buf: bytes):
+    while True:
+        if len(buf) >= 2:
+            n = buf[1] & 0x7F
+            off = 2
+            if n == 126:
+                if len(buf) >= 4:
+                    n = struct.unpack(">H", buf[2:4])[0]
+                    off = 4
+                else:
+                    n = None
+            if n is not None and len(buf) >= off + n:
+                payload = buf[off:off + n]
+                return json.loads(payload), buf[off + n:]
+        chunk = s.recv(4096)
+        if not chunk:
+            raise ConnectionError("server closed")
+        buf += chunk
+
+
+def test_websocket_subscribe_receives_events():
+    bus = EventBus()
+    server = RPCServer(RPCEnvironment(chain_id="ws", event_bus=bus))
+    server.start()
+    try:
+        s, buf = _ws_connect(*server.addr)
+        _send_text(s, {"jsonrpc": "2.0", "id": 1, "method": "subscribe",
+                       "params": {"query": "tm.event = 'Tx'"}})
+        resp, buf = _read_frame(s, buf)
+        assert resp["id"] == 1 and "result" in resp
+
+        # publish matching + non-matching; only the match is pushed
+        class _Res:
+            code = 0
+            events = []
+        bus.publish_new_block_header(type("H", (), {"height": 9})())
+        bus.publish_tx(4, 0, b"wstx=1", _Res())
+        event, buf = _read_frame(s, buf)
+        assert event["method"] == "event"
+        assert event["params"]["kind"] == "Tx"
+        assert event["params"]["attrs"]["tx.height"] == ["4"]
+
+        # bad query errors cleanly
+        _send_text(s, {"jsonrpc": "2.0", "id": 2, "method": "subscribe",
+                       "params": {"query": ""}})
+        resp, buf = _read_frame(s, buf)
+        assert resp["id"] == 2 and "error" in resp
+
+        _send_text(s, {"jsonrpc": "2.0", "id": 3,
+                       "method": "unsubscribe_all"})
+        resp, buf = _read_frame(s, buf)
+        assert resp["id"] == 3
+        s.close()
+        # server-side subscription cleaned up
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                bus.server.num_subscriptions():
+            time.sleep(0.05)
+        assert bus.server.num_subscriptions() == 0
+    finally:
+        server.stop()
