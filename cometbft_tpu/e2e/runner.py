@@ -116,8 +116,10 @@ class Testnet:
 
     # --- lifecycle (runner/start.go) -----------------------------------------
 
-    def start_node(self, node: NodeProc) -> None:
+    def start_node(self, node: NodeProc,
+                   extra_env: Optional[Dict[str, str]] = None) -> None:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(extra_env or {})
         log = open(node.log_path, "ab")
         node.proc = subprocess.Popen(
             [sys.executable, "-m", "cometbft_tpu.cmd.main", "start",

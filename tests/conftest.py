@@ -27,3 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from cometbft_tpu.libs.jax_cache import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / perturbation tests")

@@ -1,0 +1,56 @@
+"""Crash-recovery matrix: kill one validator at EVERY fail-point class
+in the commit path and verify it restarts, replays its WAL, and rejoins
+without double-signing or forking (VERDICT r3 item 3's kill-and-replay
+criterion; reference internal/consensus/replay_test.go's
+crashing-WAL classes + internal/fail FAIL_TEST_INDEX).
+
+Fail points crossed per commit, in order:
+  0 finalize:pre-save          (before the block is persisted)
+  1 finalize:post-save         (block saved, no #ENDHEIGHT yet)
+  2 finalize:post-endheight    (WAL closed, app not yet mutated)
+  3 apply_block:pre-finalize   (before ABCI FinalizeBlock)
+  4 apply_block:post-finalize  (app ran, response not saved)
+  5 apply_block:post-save-response (before app commit/state save)
+"""
+
+import time
+
+import pytest
+
+from cometbft_tpu.e2e.runner import Manifest, Testnet
+
+MANIFEST = Manifest(chain_id="crash-net", validators=4,
+                    timeout_commit_ms=50)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fail_index", [0, 1, 2, 3, 4, 5])
+def test_kill_at_fail_point_then_recover(tmp_path, fail_index):
+    net = Testnet(MANIFEST, str(tmp_path / "net"))
+    net.setup()
+    victim = net.nodes[3]
+    for node in net.nodes[:3]:
+        net.start_node(node)
+    # the victim crashes at the chosen point of its FIRST commit
+    net.start_node(victim, extra_env={
+        "COMETBFT_TPU_FAIL_INDEX": str(fail_index)})
+    try:
+        # survivors keep committing through the victim's crash
+        net.wait_for_height(3, timeout=300, nodes=net.nodes[:3])
+        # victim process must have died with the fail-point exit code
+        deadline = time.monotonic() + 60
+        while victim.proc.poll() is None and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert victim.proc.poll() == 99, \
+            f"victim exit {victim.proc.poll()} (expected fail-point 99)"
+        victim.proc = None
+
+        # restart clean: WAL replay + blocksync catch-up + rejoin
+        h_now = net.nodes[0].rpc().status()["sync_info"][
+            "latest_block_height"]
+        net.start_node(victim)
+        net.wait_for_height(h_now + 2, timeout=300, nodes=[victim])
+        net.check_no_fork(2)
+    finally:
+        net.stop()
