@@ -16,10 +16,12 @@ from ..types.block import BlockID
 
 
 class HeightVoteSet:
-    def __init__(self, chain_id: str, height: int, val_set):
+    def __init__(self, chain_id: str, height: int, val_set,
+                 extensions_enabled: bool = False):
         self.chain_id = chain_id
         self.height = height
         self.val_set = val_set
+        self.extensions_enabled = extensions_enabled
         self.round = 0
         self._sets: Dict[Tuple[int, int], VoteSet] = {}
         self._peer_catchup_rounds: Dict[str, list] = {}
@@ -34,8 +36,10 @@ class HeightVoteSet:
         key = (round_, type_)
         vs = self._sets.get(key)
         if vs is None and create:
+            # extensions only apply to precommits (types/vote_set.go)
+            ext = self.extensions_enabled and type_ == PRECOMMIT_TYPE
             vs = VoteSet(self.chain_id, self.height, round_, type_,
-                         self.val_set)
+                         self.val_set, extensions_enabled=ext)
             self._sets[key] = vs
         return vs
 

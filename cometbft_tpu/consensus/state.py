@@ -302,7 +302,10 @@ class ConsensusState:
             height=height,
             round=0,
             step=STEP_NEW_HEIGHT,
-            votes=HeightVoteSet(self.chain_id, height, state.validators),
+            votes=HeightVoteSet(
+                self.chain_id, height, state.validators,
+                extensions_enabled=state.consensus_params
+                .extensions_enabled(height)),
             last_commit=last_precommits,
         )
 
@@ -645,8 +648,19 @@ class ConsensusState:
         vote = Vote(type_=type_, height=rs.height, round=rs.round,
                     block_id=bid, timestamp=Timestamp.now(),
                     validator_address=addr, validator_index=idx)
+        extensions = self.state.consensus_params.extensions_enabled(
+            rs.height)
+        if extensions and type_ == PRECOMMIT_TYPE and not bid.is_nil():
+            # ABCI ExtendVote (reference state.go:2471 signAddVote →
+            # app.ExtendVote; the extension rides the precommit)
+            try:
+                vote.extension = self.executor.app.extend_vote(
+                    rs.height, rs.round)
+            except Exception:  # noqa: BLE001 — app bug ≠ missed vote
+                vote.extension = b""
         try:
-            self.priv_validator.sign_vote(self.chain_id, vote)
+            self.priv_validator.sign_vote(
+                self.chain_id, vote, sign_extension=extensions)
         except DoubleSignError:
             return  # never sign conflicting votes; stay silent
         self.handle_msg(VoteMessage(vote))
@@ -678,6 +692,35 @@ class ConsensusState:
             return
         if vote.height != rs.height:
             return
+
+        # ABCI VerifyVoteExtension on peer precommits (reference
+        # state.go addVote → blockExec.VerifyVoteExtension). Order
+        # matters: authenticate the extension signature against the
+        # validator's key FIRST (the main vote signature does not cover
+        # the extension — unauthenticated bytes must never reach the
+        # app or suppress a valid vote), and skip duplicates so gossip
+        # re-deliveries don't cost an app round-trip each.
+        if peer_id and vote.type_ == PRECOMMIT_TYPE and \
+                not vote.block_id.is_nil() and \
+                self.state.consensus_params.extensions_enabled(rs.height):
+            existing = rs.votes.precommits(vote.round).get_by_index(
+                vote.validator_index)
+            if existing is None:
+                _idx, val = self.state.validators.get_by_address(
+                    vote.validator_address)
+                if val is None or not vote.extension_signature or \
+                        not val.pub_key.verify_signature(
+                            vote.extension_sign_bytes(self.chain_id),
+                            vote.extension_signature):
+                    raise VoteError("bad vote extension signature")
+                try:
+                    ok = self.executor.app.verify_vote_extension(
+                        vote.height, vote.validator_address,
+                        vote.extension)
+                except Exception:  # noqa: BLE001
+                    ok = False
+                if not ok:
+                    raise VoteError("app rejected vote extension")
 
         rs.votes.add_vote(vote, peer_id)
         if vote.type_ == PREVOTE_TYPE:

@@ -47,7 +47,8 @@ class PrivValidator(Protocol):
     """reference types/priv_validator.go:14-23."""
 
     def get_pub_key(self) -> PubKey: ...
-    def sign_vote(self, chain_id: str, vote: Vote) -> None: ...
+    def sign_vote(self, chain_id: str, vote: Vote,
+                  sign_extension: bool = False) -> None: ...
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None: ...
 
 
@@ -213,9 +214,14 @@ class FilePV:
     def address(self) -> bytes:
         return self.get_pub_key().address()
 
-    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+    def sign_vote(self, chain_id: str, vote: Vote,
+                  sign_extension: bool = False) -> None:
         """Sets vote.signature (reference privval/file.go:237 SignVote →
-        :308-360 signVote). Raises DoubleSignError on a conflict."""
+        :308-360 signVote). Raises DoubleSignError on a conflict.
+        With sign_extension, non-nil precommits also get
+        extension_signature (reference signs both in one SignVote; ed25519
+        signing is deterministic so the retry path re-derives identical
+        extension bytes)."""
         step = vote_to_step(vote.type_)
         sb = vote.sign_bytes(chain_id)
         same_hrs = self.last.check_hrs(vote.height, vote.round, step)
@@ -224,12 +230,22 @@ class FilePV:
                 self.last.sign_bytes, sb, _strip_vote_timestamp)
             if identical or ts_only:
                 vote.signature = self.last.signature
+                self._maybe_sign_extension(chain_id, vote, sign_extension)
                 return
             raise DoubleSignError(
                 f"conflicting vote at {vote.height}/{vote.round}/{step}")
         sig = self.priv_key.sign(sb)
         self._record(vote.height, vote.round, step, sb, sig)
         vote.signature = sig
+        self._maybe_sign_extension(chain_id, vote, sign_extension)
+
+    def _maybe_sign_extension(self, chain_id: str, vote: Vote,
+                              sign_extension: bool) -> None:
+        from ..types.vote import PRECOMMIT_TYPE
+        if sign_extension and vote.type_ == PRECOMMIT_TYPE and \
+                not vote.block_id.is_nil():
+            vote.extension_signature = self.priv_key.sign(
+                vote.extension_sign_bytes(chain_id))
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         """reference privval/file.go:262 SignProposal → :363-411."""

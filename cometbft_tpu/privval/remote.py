@@ -24,7 +24,7 @@ from ..p2p.conn import SecretConnection
 from ..types import proto
 from ..types.block import BlockID, PartSetHeader
 from ..types.proto import Timestamp
-from ..types.vote import Proposal, Vote
+from ..types.vote import Proposal, Vote, PRECOMMIT_TYPE
 from .file import DoubleSignError, FilePV
 
 _M_PUBKEY = 1
@@ -49,7 +49,8 @@ def _vote_to_json(v: Vote) -> dict:
             "bid_parts": v.block_id.parts.hash.hex(),
             "ts": [v.timestamp.seconds, v.timestamp.nanos],
             "val_addr": v.validator_address.hex(),
-            "val_idx": v.validator_index}
+            "val_idx": v.validator_index,
+            "extension": v.extension.hex()}
 
 
 def _vote_from_json(d: dict) -> Vote:
@@ -59,7 +60,8 @@ def _vote_from_json(d: dict) -> Vote:
                                                bytes.fromhex(d["bid_parts"]))),
                 timestamp=Timestamp(*d["ts"]),
                 validator_address=bytes.fromhex(d["val_addr"]),
-                validator_index=d["val_idx"])
+                validator_index=d["val_idx"],
+                extension=bytes.fromhex(d.get("extension", "")))
 
 
 def _proposal_to_json(p: Proposal) -> dict:
@@ -115,8 +117,12 @@ class SignerServer:
             elif method == _M_SIGN_VOTE:
                 vote = _vote_from_json(body["vote"])
                 try:
-                    self.pv.sign_vote(body["chain_id"], vote)
-                    _send(sc, method, {"sig": vote.signature.hex()})
+                    self.pv.sign_vote(
+                        body["chain_id"], vote,
+                        sign_extension=body.get("sign_extension", False))
+                    _send(sc, method, {
+                        "sig": vote.signature.hex(),
+                        "ext_sig": vote.extension_signature.hex()})
                 except DoubleSignError as e:
                     _send(sc, method, {"error": str(e)})
             elif method == _M_SIGN_PROPOSAL:
@@ -173,12 +179,21 @@ class SignerClient:
         return Ed25519PubKey(
             bytes.fromhex(self._call(_M_PUBKEY, {})["pub_key"]))
 
-    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+    def sign_vote(self, chain_id: str, vote: Vote,
+                  sign_extension: bool = False) -> None:
         resp = self._call(_M_SIGN_VOTE, {
-            "chain_id": chain_id, "vote": _vote_to_json(vote)})
+            "chain_id": chain_id, "vote": _vote_to_json(vote),
+            "sign_extension": sign_extension})
         if "error" in resp:
             raise DoubleSignError(resp["error"])
         vote.signature = bytes.fromhex(resp["sig"])
+        vote.extension_signature = bytes.fromhex(resp.get("ext_sig", ""))
+        if sign_extension and vote.type_ == PRECOMMIT_TYPE and \
+                not vote.block_id.is_nil() and not vote.extension_signature:
+            # an extension-unsigned precommit would be silently rejected
+            # by every peer — surface the signer misconfiguration here
+            raise ConnectionError(
+                "signer did not return an extension signature")
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         resp = self._call(_M_SIGN_PROPOSAL, {

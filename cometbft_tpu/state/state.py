@@ -27,6 +27,13 @@ class ConsensusParams:
     evidence_max_age_seconds: int = 172_800
     evidence_max_bytes: int = 1_048_576
     pbts_enable_height: int = 0
+    # ABCI vote extensions activate at this height; 0 = disabled
+    # (reference types/params.go ABCIParams.VoteExtensionsEnableHeight)
+    vote_extensions_enable_height: int = 0
+
+    def extensions_enabled(self, height: int) -> bool:
+        return (self.vote_extensions_enable_height > 0
+                and height >= self.vote_extensions_enable_height)
 
     def hash(self) -> bytes:
         """Wire-normative digest: sha256 over proto(HashedParams) which
@@ -231,6 +238,8 @@ def _state_to_json(s: State) -> bytes:
                 s.consensus_params.evidence_max_age_seconds,
             "evidence_max_bytes": s.consensus_params.evidence_max_bytes,
             "pbts_enable_height": s.consensus_params.pbts_enable_height,
+            "vote_extensions_enable_height":
+                s.consensus_params.vote_extensions_enable_height,
         },
     }).encode()
 

@@ -1,0 +1,183 @@
+"""Statesync p2p reactor: snapshot discovery + chunk transfer over the
+switch (reference internal/statesync/reactor.go, snapshots/chunks over
+SnapshotChannel 0x60 / ChunkChannel 0x61).
+
+Wire (channel 0x60): kind 1 SnapshotsRequest, kind 2 SnapshotsResponse
+(repeated embedded snapshots). Channel 0x61: kind 3 ChunkRequest
+{height, format, index}, kind 4 ChunkResponse {height, format, index,
+chunk, missing}.
+`NetSnapshotSource` adapts a connected peer set into the Syncer's
+SnapshotSource protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+from ..abci.application import Snapshot
+from ..p2p.mconn import ChannelDescriptor
+from ..types import proto
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+_SNAP_REQ = 1
+_SNAP_RESP = 2
+_CHUNK_REQ = 3
+_CHUNK_RESP = 4
+
+
+def _encode_snapshot(s: Snapshot) -> bytes:
+    return (proto.f_varint(1, s.height) + proto.f_varint(2, s.format)
+            + proto.f_varint(3, s.chunks) + proto.f_bytes(4, s.hash)
+            + proto.f_bytes(5, s.metadata))
+
+
+def _decode_snapshot(b: bytes) -> Snapshot:
+    f = proto.parse_fields(b)
+    return Snapshot(height=proto.field_int(f, 1, 0),
+                    format=proto.field_int(f, 2, 0),
+                    chunks=proto.field_int(f, 3, 0),
+                    hash=proto.field_bytes(f, 4, b""),
+                    metadata=proto.field_bytes(f, 5, b""))
+
+
+class StatesyncNetReactor:
+    """Serves the local app's snapshots and fetches remote ones."""
+
+    def __init__(self, app):
+        self.app = app
+        self._peers: Dict[str, object] = {}
+        self._snapshots: Dict[str, List[Snapshot]] = {}
+        self._pending_chunks: Dict[Tuple[int, int, int], List[Future]] = {}
+        self._snap_waiters: List[Future] = []
+        self._lock = threading.Lock()
+
+    # --- p2p.Reactor ----------------------------------------------------------
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [ChannelDescriptor(id=SNAPSHOT_CHANNEL, priority=3),
+                ChannelDescriptor(id=CHUNK_CHANNEL, priority=1,
+                                  recv_message_capacity=32 * 1024 * 1024)]
+
+    def add_peer(self, peer) -> None:
+        with self._lock:
+            self._peers[peer.id] = peer
+        peer.try_send(SNAPSHOT_CHANNEL, bytes([_SNAP_REQ]))
+
+    def remove_peer(self, peer, reason: str) -> None:
+        with self._lock:
+            self._peers.pop(peer.id, None)
+            self._snapshots.pop(peer.id, None)
+
+    def receive(self, channel_id: int, peer, raw: bytes) -> None:
+        if not raw:
+            raise ValueError("empty statesync message")
+        kind, body = raw[0], raw[1:]
+        if kind == _SNAP_REQ:
+            snaps = self.app.list_snapshots()
+            out = b"".join(proto.f_embed(1, _encode_snapshot(s))
+                           for s in snaps[:16])
+            peer.try_send(SNAPSHOT_CHANNEL, bytes([_SNAP_RESP]) + out)
+        elif kind == _SNAP_RESP:
+            f = proto.parse_fields(body)
+            snaps = [_decode_snapshot(b)
+                     for b in proto.field_all_bytes(f, 1)]
+            with self._lock:
+                self._snapshots[peer.id] = snaps
+                waiters, self._snap_waiters = self._snap_waiters, []
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_result(True)
+        elif kind == _CHUNK_REQ:
+            f = proto.parse_fields(body)
+            h = proto.field_int(f, 1, 0)
+            fmt = proto.field_int(f, 2, 0)
+            idx = proto.field_int(f, 3, 0)
+            chunk = self.app.load_snapshot_chunk(h, fmt, idx)
+            resp = (proto.f_varint(1, h) + proto.f_varint(2, fmt)
+                    + proto.f_varint(3, idx) + proto.f_bytes(4, chunk)
+                    + proto.f_varint(5, 0 if chunk else 1))
+            peer.try_send(CHUNK_CHANNEL, bytes([_CHUNK_RESP]) + resp)
+        elif kind == _CHUNK_RESP:
+            f = proto.parse_fields(body)
+            key = (proto.field_int(f, 1, 0), proto.field_int(f, 2, 0),
+                   proto.field_int(f, 3, 0))
+            missing = proto.field_int(f, 5, 0)
+            chunk = None if missing else proto.field_bytes(f, 4, b"")
+            with self._lock:
+                futs = self._pending_chunks.pop(key, [])
+            for fut in futs:
+                if not fut.done():
+                    fut.set_result(chunk)
+        else:
+            raise ValueError(f"unknown statesync message kind {kind}")
+
+    # --- client API -----------------------------------------------------------
+
+    def discover_snapshots(self, timeout: float = 5.0
+                           ) -> List[Tuple[Snapshot, str]]:
+        with self._lock:
+            peers = list(self._peers.values())
+            fut: Future = Future()
+            self._snap_waiters.append(fut)
+        for p in peers:
+            p.try_send(SNAPSHOT_CHANNEL, bytes([_SNAP_REQ]))
+        try:
+            fut.result(timeout=timeout)
+        except Exception:
+            pass
+        with self._lock:
+            return [(s, pid) for pid, snaps in self._snapshots.items()
+                    for s in snaps]
+
+    def fetch_chunk(self, peer_id: str, height: int, format_: int,
+                    index: int, timeout: float = 30.0) -> Optional[bytes]:
+        with self._lock:
+            peer = self._peers.get(peer_id)
+            if peer is None:
+                return None
+            key = (height, format_, index)
+            fut: Future = Future()
+            self._pending_chunks.setdefault(key, []).append(fut)
+        peer.try_send(CHUNK_CHANNEL, bytes([_CHUNK_REQ])
+                      + proto.f_varint(1, height)
+                      + proto.f_varint(2, format_)
+                      + proto.f_varint(3, index))
+        try:
+            return fut.result(timeout=timeout)
+        except Exception:
+            return None
+
+
+class NetSnapshotSource:
+    """Syncer.SnapshotSource over one serving peer."""
+
+    def __init__(self, reactor: StatesyncNetReactor, peer_id: str,
+                 snapshots: List[Snapshot]):
+        self.reactor = reactor
+        self.peer_id = peer_id
+        self._snapshots = snapshots
+
+    def list_snapshots(self) -> List[Snapshot]:
+        return self._snapshots
+
+    def fetch_chunk(self, height: int, format_: int, chunk: int) -> bytes:
+        got = self.reactor.fetch_chunk(self.peer_id, height, format_,
+                                       chunk)
+        if got is None:
+            raise ConnectionError(
+                f"peer {self.peer_id[:8]} failed chunk {chunk}")
+        return got
+
+
+def net_snapshot_sources(reactor: StatesyncNetReactor
+                         ) -> List[NetSnapshotSource]:
+    """Group discovered snapshots per serving peer."""
+    by_peer: Dict[str, List[Snapshot]] = {}
+    for snap, pid in reactor.discover_snapshots():
+        by_peer.setdefault(pid, []).append(snap)
+    return [NetSnapshotSource(reactor, pid, snaps)
+            for pid, snaps in by_peer.items()]

@@ -124,6 +124,12 @@ class VoteSet:
         Shared by add_vote and add_votes' non-batched fallback."""
         addr = vote.validator_address
         if self.extensions_enabled:
+            if vote.block_id.is_nil() and \
+                    (vote.extension or vote.extension_signature):
+                # reference Vote.ValidateBasic: extensions only ride
+                # non-nil precommits — unsigned bytes on a nil vote
+                # would be stored and re-gossiped otherwise
+                raise VoteError("extension data on nil precommit")
             if not vote.verify_vote_and_extension(self.chain_id,
                                                   val.pub_key):
                 raise ErrVoteInvalidSignature(

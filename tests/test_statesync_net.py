@@ -1,0 +1,67 @@
+"""Statesync over real TCP: a fresh node discovers a snapshot from a
+peer, streams chunks over the chunk channel, and restores with the
+light-client anchor (reference internal/statesync/reactor_test.go)."""
+
+import time
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.db.kv import MemDB
+from cometbft_tpu.engine.chain_gen import generate_chain
+from cometbft_tpu.light import LightClient, LightStore, TrustOptions
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State
+from cometbft_tpu.statesync.reactor import (StatesyncNetReactor,
+                                            net_snapshot_sources)
+from cometbft_tpu.statesync.stateprovider import LightStateProvider
+from cometbft_tpu.statesync.syncer import Syncer
+from cometbft_tpu.types.proto import Timestamp
+
+from test_light import ChainProvider
+
+
+def test_statesync_over_tcp():
+    chain = generate_chain(12, n_validators=4, txs_per_block=2)
+    serving_app = KVStoreApplication()
+    serving_app.init_chain(chain.chain_id, 1, [], b"")
+    ex = BlockExecutor(serving_app)
+    st = State.from_genesis(chain.genesis)
+    for h in range(1, 11):  # stop at 10: headers 11,12 anchor the trust
+        st, _ = ex.apply_block(st, chain.block_ids[h - 1],
+                               chain.blocks[h - 1], verified=True)
+    serving_app.list_snapshots()  # capture the snapshot blob
+
+    sw_a = Switch(Ed25519PrivKey.generate(), chain.chain_id, "server")
+    sw_b = Switch(Ed25519PrivKey.generate(), chain.chain_id, "syncer")
+    ra = StatesyncNetReactor(serving_app)
+    fresh_app = KVStoreApplication()
+    rb = StatesyncNetReactor(fresh_app)
+    sw_a.add_reactor(ra)
+    sw_b.add_reactor(rb)
+    try:
+        host, port = sw_a.listen()
+        sw_b.dial(host, port)
+        deadline = time.monotonic() + 10
+        while not sw_b.peers() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sw_b.peers()
+
+        sources = net_snapshot_sources(rb)
+        assert sources and sources[0].list_snapshots()[0].height == 10
+
+        lc = LightClient(
+            chain.chain_id,
+            TrustOptions(period_seconds=10**9, height=1,
+                         hash=chain.blocks[0].hash()),
+            ChainProvider(chain), [], LightStore(MemDB()),
+            now_fn=lambda: Timestamp(1_700_000_000 + 20, 0))
+        syncer = Syncer(fresh_app, LightStateProvider(lc, chain.genesis),
+                        sources)
+        state = syncer.sync()
+        assert state.last_block_height == 10
+        assert fresh_app.state == serving_app.state
+        assert fresh_app.last_app_hash == serving_app.last_app_hash
+    finally:
+        sw_a.stop()
+        sw_b.stop()
