@@ -66,6 +66,9 @@ def cmd_start(args) -> int:
     if args.persistent_peers:
         cfg.p2p.persistent_peers = args.persistent_peers
     node = Node(cfg, KVStoreApplication())
+    node.consensus.on_commit = lambda block, commit: print(
+        f"committed height={block.header.height} "
+        f"round={commit.round} txs={len(block.data.txs)}", flush=True)
     node.start()
     print(f"node started: p2p={node.p2p_addr} "
           f"rpc={node.rpc_server.addr if node.rpc_server else None}",

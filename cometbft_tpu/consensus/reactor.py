@@ -13,12 +13,13 @@ Wire: u8 kind || body. kinds: 1 proposal, 2 block part, 3 vote.
 
 from __future__ import annotations
 
+import time
 from typing import List
 
 from ..p2p.mconn import ChannelDescriptor
 from ..types import proto
-from ..types.block import Part
-from ..types.vote import Vote
+from ..types.block import Commit, Part
+from ..types.vote import Vote, PRECOMMIT_TYPE
 from .state import (BlockPartMessage, ConsensusState, Message,
                     ProposalMessage, VoteMessage)
 from .wal import _decode_proposal, _encode_proposal
@@ -61,6 +62,23 @@ def decode_consensus_msg(raw: bytes) -> Message:
     raise ValueError(f"unknown consensus wire kind {kind}")
 
 
+def votes_from_commit(commit: Commit) -> List[Vote]:
+    """Reconstruct the signed precommits a Commit attests to (the
+    reference's VoteSet-from-commit path, types/vote_set.go
+    CommitToVoteSet) — what a lagging peer needs to cross its 2/3
+    threshold for an already-decided height."""
+    votes = []
+    for idx, cs in enumerate(commit.signatures):
+        if cs.absent_():
+            continue
+        votes.append(Vote(
+            type_=PRECOMMIT_TYPE, height=commit.height,
+            round=commit.round, block_id=cs.block_id(commit.block_id),
+            timestamp=cs.timestamp, validator_address=cs.validator_address,
+            validator_index=idx, signature=cs.signature))
+    return votes
+
+
 class ConsensusReactor:
     """p2p.Reactor wrapping a ConsensusState."""
 
@@ -68,6 +86,10 @@ class ConsensusReactor:
         self.cs = cs
         self._switch = None
         cs.broadcast = self._broadcast
+        # (peer_id, height) -> monotonic time of last catch-up help;
+        # keeps a stuck peer's once-per-round nil votes from triggering
+        # a full commit+parts resend each time
+        self._catchup_sent: dict = {}
 
     def attach(self, switch) -> None:
         self._switch = switch
@@ -98,7 +120,64 @@ class ConsensusReactor:
 
     def receive(self, channel_id: int, peer, raw: bytes) -> None:
         msg = decode_consensus_msg(raw)
+        if isinstance(msg, VoteMessage):
+            self._maybe_catchup_peer(msg.vote, peer)
         self.cs.send(msg, peer_id=peer.id)
+
+    def _maybe_catchup_peer(self, vote: Vote, peer) -> None:
+        """A vote for a height below ours means the peer is lagging: feed
+        it the decided commit's precommits, then the block parts, from
+        the store. Liveness depends on this — gossip here is
+        broadcast-once, so a peer that missed a vote or part at height H
+        would otherwise cycle rounds at H forever while the rest of the
+        cluster moves on (and with <=1/3 of power it can never commit H
+        alone). The reference covers this with its per-peer
+        gossipDataRoutine/gossipVotesRoutine, which stream old-height
+        commits to behind peers (internal/consensus/reactor.go:570,625);
+        without per-peer round-state tracking, the laggard's own
+        once-per-round vote broadcasts are the trigger instead.
+
+        Order matters: votes first (their 2/3 majority makes the laggard
+        enter STEP_COMMIT and allocate the PartSet for the decided
+        block_id), then parts (which complete it and finalize)."""
+        h = vote.height
+        cs = self.cs
+        store = cs.block_store
+        if h >= cs.rs.height or store is None:
+            return
+        if not (store.base() <= h <= store.height()):
+            return
+        now = time.monotonic()
+        key = (peer.id, h)
+        if now - self._catchup_sent.get(key, 0.0) < 2.0:
+            return
+        if len(self._catchup_sent) > 4096:
+            cutoff = now - 60.0
+            self._catchup_sent = {k: t for k, t in
+                                  self._catchup_sent.items() if t > cutoff}
+        self._catchup_sent[key] = now
+        commit = store.load_seen_commit(h) or store.load_block_commit(h)
+        if commit is None:
+            return
+        if not cs.state.consensus_params.extensions_enabled(h):
+            # reconstructed votes cannot carry extension signatures and
+            # extension-checking vote sets reject votes without them, so
+            # under extensions only the parts are served — enough for a
+            # peer parked in STEP_COMMIT (it already holds 2/3
+            # precommits); a rounds-cycling extension-era laggard
+            # catches up via blocksync on restart instead
+            for v in votes_from_commit(commit):
+                ch, raw = encode_consensus_msg(VoteMessage(v))
+                peer.try_send(ch, raw)
+        block = store.load_block(h)
+        if block is None:
+            return
+        # the store keeps raw part bytes; re-chunking the block rebuilds
+        # the identical part set (deterministic split + merkle proofs)
+        for part in block.make_part_set().parts:
+            ch, raw = encode_consensus_msg(
+                BlockPartMessage(h, commit.round, part))
+            peer.try_send(ch, raw)
 
     def _broadcast(self, msg: Message) -> None:
         if self._switch is None:

@@ -283,6 +283,8 @@ class ConsensusState:
         elif ti.step == STEP_PRECOMMIT_WAIT:
             self._enter_precommit(ti.height, ti.round)
             self._enter_new_round(ti.height, ti.round + 1)
+        elif ti.step == STEP_COMMIT:
+            self._commit_retry()
 
     # --- height/round transitions -------------------------------------------
 
@@ -442,7 +444,15 @@ class ConsensusState:
             block = Block.decode(rs.proposal_block_parts.reassemble())
         except (ValueError, IndexError):
             return
-        if rs.proposal is not None and \
+        if rs.step == STEP_COMMIT:
+            # catch-up: the part set was allocated from the
+            # 2/3-precommitted block_id (enterCommit), possibly while a
+            # stale same-height proposal from a later round is still in
+            # rs.proposal — authenticate against the decided id, not it
+            bid = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+            if bid is not None and block.hash() != bid.hash:
+                return
+        elif rs.proposal is not None and \
                 block.hash() != rs.proposal.block_id.hash:
             return  # parts complete but wrong block: proposer lied
         rs.proposal_block = block
@@ -586,8 +596,40 @@ class ConsensusState:
                     rs.proposal_block_parts.header != bid.parts:
                 rs.proposal_block = None
                 rs.proposal_block_parts = PartSet.new_from_header(bid.parts)
-            return  # wait for parts
+            # waiting for parts: a node parked here is SILENT (it votes
+            # no more this height), so nothing would ever trigger the
+            # reactor-side laggard catch-up and a lost part would stall
+            # it forever — keep poking peers until the block completes
+            self._schedule_commit_retry()
+            return
         self._try_finalize_commit(height)
+
+    def _schedule_commit_retry(self) -> None:
+        self.ticker.schedule(TimeoutInfo(
+            max(self.config.timeout_precommit, 500), self.rs.height,
+            self.rs.round, STEP_COMMIT))
+
+    def _commit_retry(self) -> None:
+        """Still in STEP_COMMIT with an incomplete decided block:
+        re-broadcast a precommit for this height (peers answer votes for
+        below-tip heights with the full commit + parts — the catch-up
+        path in consensus/reactor.py) and re-arm."""
+        rs = self.rs
+        if rs.step != STEP_COMMIT or rs.proposal_block is not None:
+            return
+        vs = rs.votes.precommits(rs.commit_round)
+        vote = None
+        if self._priv_pubkey is not None:
+            idx, _ = self.state.validators.get_by_address(
+                self._priv_pubkey.address())
+            if idx is not None and idx >= 0:
+                vote = vs.get_by_index(idx)
+        if vote is None:
+            votes = vs.list_votes()
+            vote = votes[0] if votes else None
+        if vote is not None and not self._replaying:
+            self.broadcast(VoteMessage(vote))
+        self._schedule_commit_retry()
 
     def _try_finalize_commit(self, height: int) -> None:
         """reference state.go:1645-1671."""
@@ -656,8 +698,14 @@ class ConsensusState:
             try:
                 vote.extension = self.executor.app.extend_vote(
                     rs.height, rs.round)
-            except Exception:  # noqa: BLE001 — app bug ≠ missed vote
-                vote.extension = b""
+            except Exception:  # noqa: BLE001
+                # abstain loudly: signing an empty extension instead
+                # would produce a precommit every peer's
+                # VerifyVoteExtension rejects — an invisible missed
+                # vote (the reference panics here, state.go:2510)
+                import traceback
+                traceback.print_exc()
+                return
         try:
             self.priv_validator.sign_vote(
                 self.chain_id, vote, sign_extension=extensions)

@@ -51,8 +51,11 @@ class StatesyncNetReactor:
         self.app = app
         self._peers: Dict[str, object] = {}
         self._snapshots: Dict[str, List[Snapshot]] = {}
-        self._pending_chunks: Dict[Tuple[int, int, int], List[Future]] = {}
-        self._snap_waiters: List[Future] = []
+        # (height, format, index) -> [(serving peer_id, Future)]
+        self._pending_chunks: Dict[Tuple[int, int, int],
+                                   List[Tuple[str, Future]]] = {}
+        # discovery waiters: (future, peer ids still to answer)
+        self._snap_waiters: List[Tuple[Future, set]] = []
         self._lock = threading.Lock()
 
     # --- p2p.Reactor ----------------------------------------------------------
@@ -71,6 +74,32 @@ class StatesyncNetReactor:
         with self._lock:
             self._peers.pop(peer.id, None)
             self._snapshots.pop(peer.id, None)
+            # fail this peer's in-flight chunk fetches immediately (the
+            # syncer re-requests elsewhere) instead of letting callers
+            # block out their full timeout, and stop discovery waiting
+            # on an answer that will never come
+            dead: List[Future] = []
+            for key in list(self._pending_chunks):
+                rest = []
+                for pid, f in self._pending_chunks[key]:
+                    (dead if pid == peer.id else rest).append((pid, f))
+                if rest:
+                    self._pending_chunks[key] = rest
+                else:
+                    del self._pending_chunks[key]
+            done_waiters: List[Future] = []
+            for fut, pending in self._snap_waiters:
+                pending.discard(peer.id)
+                if not pending:
+                    done_waiters.append(fut)
+            self._snap_waiters = [(f, p) for f, p in self._snap_waiters
+                                  if p]
+        for _pid, fut in dead:
+            if not fut.done():
+                fut.set_result(None)
+        for fut in done_waiters:
+            if not fut.done():
+                fut.set_result(True)
 
     def receive(self, channel_id: int, peer, raw: bytes) -> None:
         if not raw:
@@ -85,10 +114,16 @@ class StatesyncNetReactor:
             f = proto.parse_fields(body)
             snaps = [_decode_snapshot(b)
                      for b in proto.field_all_bytes(f, 1)]
+            done_waiters: List[Future] = []
             with self._lock:
                 self._snapshots[peer.id] = snaps
-                waiters, self._snap_waiters = self._snap_waiters, []
-            for fut in waiters:
+                for fut, pending in self._snap_waiters:
+                    pending.discard(peer.id)
+                    if not pending:
+                        done_waiters.append(fut)
+                self._snap_waiters = [(f, p) for f, p in
+                                      self._snap_waiters if p]
+            for fut in done_waiters:
                 if not fut.done():
                     fut.set_result(True)
         elif kind == _CHUNK_REQ:
@@ -109,7 +144,7 @@ class StatesyncNetReactor:
             chunk = None if missing else proto.field_bytes(f, 4, b"")
             with self._lock:
                 futs = self._pending_chunks.pop(key, [])
-            for fut in futs:
+            for _pid, fut in futs:
                 if not fut.done():
                     fut.set_result(chunk)
         else:
@@ -122,13 +157,23 @@ class StatesyncNetReactor:
         with self._lock:
             peers = list(self._peers.values())
             fut: Future = Future()
-            self._snap_waiters.append(fut)
+            # the waiter resolves when EVERY queried peer has answered
+            # (or left) — a fast empty response must not mask a slower
+            # peer that does hold a snapshot
+            pending = {p.id for p in peers}
+            if pending:
+                self._snap_waiters.append((fut, pending))
+            else:
+                fut.set_result(True)
         for p in peers:
             p.try_send(SNAPSHOT_CHANNEL, bytes([_SNAP_REQ]))
         try:
             fut.result(timeout=timeout)
         except Exception:
             pass
+        with self._lock:
+            self._snap_waiters = [(f, p) for f, p in self._snap_waiters
+                                  if f is not fut]
         with self._lock:
             return [(s, pid) for pid, snaps in self._snapshots.items()
                     for s in snaps]
@@ -141,7 +186,8 @@ class StatesyncNetReactor:
                 return None
             key = (height, format_, index)
             fut: Future = Future()
-            self._pending_chunks.setdefault(key, []).append(fut)
+            self._pending_chunks.setdefault(key, []).append(
+                (peer_id, fut))
         peer.try_send(CHUNK_CHANNEL, bytes([_CHUNK_REQ])
                       + proto.f_varint(1, height)
                       + proto.f_varint(2, format_)
@@ -149,6 +195,16 @@ class StatesyncNetReactor:
         try:
             return fut.result(timeout=timeout)
         except Exception:
+            # timed out: drop the stale future so retries don't
+            # accumulate entries for the reactor's lifetime
+            with self._lock:
+                rest = [(pid, f) for pid, f in
+                        self._pending_chunks.get(key, ())
+                        if f is not fut]
+                if rest:
+                    self._pending_chunks[key] = rest
+                else:
+                    self._pending_chunks.pop(key, None)
             return None
 
 

@@ -221,3 +221,60 @@ def test_byzantine_double_sign_surfaces_conflict():
         assert target.conflicting_votes, "conflict never detected"
     finally:
         c.stop()
+
+
+def test_laggard_catchup_via_reactor():
+    """A node that missed a height's votes/parts is fed the decided
+    commit by a peer's consensus reactor and finalizes (liveness: gossip
+    is broadcast-once here, so without this path a laggard cycles rounds
+    forever — the reference covers it with gossipData/VotesRoutine,
+    internal/consensus/reactor.go:570,625)."""
+    from cometbft_tpu.consensus.reactor import (
+        ConsensusReactor, VOTE_CHANNEL, decode_consensus_msg,
+        encode_consensus_msg)
+
+    # isolate node 3 from the start: 0-2 (3/4 power) commit without it
+    c = Cluster(4, drop=lambda src, dst, msg: 3 in (src, dst))
+    try:
+        c.start()
+        deadline = time.monotonic() + 120
+        for node in c.nodes[:3]:
+            while node.cs.state.last_block_height < 2:
+                assert time.monotonic() < deadline, "survivors stuck"
+                time.sleep(0.01)
+        lag = c.nodes[3].cs
+        assert lag.state.last_block_height == 0  # stuck below the rest
+
+        # node 0's reactor sees one of the laggard's once-per-round votes
+        reactor = ConsensusReactor(c.nodes[0].cs)  # broadcast now a noop
+
+        class FakePeer:
+            id = "laggard"
+
+            def __init__(self):
+                self.sent = []
+
+            def try_send(self, ch, raw):
+                self.sent.append((ch, raw))
+                return True
+
+        for target_height in (1, 2):
+            peer = FakePeer()
+            trigger = Vote(type_=PREVOTE_TYPE, height=target_height,
+                           round=0, timestamp=Timestamp.now(),
+                           validator_address=b"\x00" * 20,
+                           validator_index=0, signature=b"\x01" * 64)
+            _, raw = encode_consensus_msg(VoteMessage(trigger))
+            reactor.receive(VOTE_CHANNEL, peer, raw)
+            assert peer.sent, f"no catch-up sent for {target_height}"
+            for ch, msg_raw in peer.sent:
+                lag.send(decode_consensus_msg(msg_raw), peer_id="node0")
+            deadline = time.monotonic() + 60
+            while lag.state.last_block_height < target_height:
+                assert time.monotonic() < deadline, (
+                    f"laggard stuck at {lag.state.last_block_height} "
+                    f"(rs h={lag.rs.height} r={lag.rs.round} "
+                    f"s={lag.rs.step})")
+                time.sleep(0.01)
+    finally:
+        c.stop()
