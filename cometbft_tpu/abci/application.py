@@ -136,6 +136,8 @@ class Application(Protocol):
     # info/query connection
     def info(self) -> ResponseInfo: ...
     def query(self, path: str, data: bytes) -> tuple[int, bytes]: ...
+    def query_prove(self, path: str, data: bytes
+                    ) -> tuple[int, bytes, int, object]: ...
 
     # mempool connection
     def check_tx(self, tx: bytes) -> CheckTxResult: ...
@@ -174,6 +176,13 @@ class BaseApplication:
 
     def query(self, path: str, data: bytes) -> tuple[int, bytes]:
         return CODE_TYPE_OK, b""
+
+    def query_prove(self, path: str, data: bytes
+                    ) -> tuple[int, bytes, int, object]:
+        """(code, value, height, proof-or-None); apps without provable
+        state answer proofless (verifying clients then reject them)."""
+        code, value = self.query(path, data)
+        return code, value, self.info().last_block_height, None
 
     def check_tx(self, tx: bytes) -> CheckTxResult:
         return CheckTxResult()

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import hashlib
 import json
-from typing import List
+from typing import List, Optional, Tuple
 
+from ..crypto import merkle
 from .application import (
     BaseApplication, CheckTxResult, ExecTxResult, RequestFinalizeBlock,
     ResponseCommit, ResponseFinalizeBlock, ResponseInfo, Snapshot,
@@ -31,14 +32,40 @@ class KVStoreApplication(BaseApplication):
         self.last_height = 0
         self.last_app_hash = b""
         self.staged: dict | None = None
+        # previous committed snapshot: the newest state whose app hash
+        # already appears in a STORED header (state at H-1 hashes into
+        # header H; the tip state's hash only lands in header H+1) —
+        # what provable queries are answered from. One attribute so a
+        # reader on the RPC thread can't tear (state, height) apart
+        # while commit() swaps them on the consensus thread.
+        self._prev: tuple | None = None
 
     # --- helpers -------------------------------------------------------------
 
+    @staticmethod
+    def kv_leaf(key: bytes, value: bytes) -> bytes:
+        """Injective leaf encoding: tag byte + length-prefixed key.
+        (A `key || 0x00 || value` form would be forgeable — a key
+        containing 0x00 lets a lying primary prove a different split of
+        the same bytes as some other pair.)"""
+        return b"\x01" + len(key).to_bytes(4, "big") + key + value
+
+    @classmethod
+    def _state_leaves(cls, state: dict, height: int) -> List[bytes]:
+        """Leaf 0 (tag 0x00) commits the height; then one kv_leaf per
+        sorted entry. The merkle root IS the app hash, so any key's
+        presence (and value) is provable against a light-verified header
+        — what the light RPC proxy's verified `abci_query` checks
+        (reference light/rpc/client.go ABCIQueryWithOptions + proof ops;
+        provable state is the app's contract there too)."""
+        leaves = [b"\x00" + height.to_bytes(8, "big")]
+        leaves.extend(cls.kv_leaf(k.encode(), state[k].encode())
+                      for k in sorted(state))
+        return leaves
+
     def _compute_app_hash(self, state: dict, height: int) -> bytes:
-        blob = json.dumps(
-            {k: state[k] for k in sorted(state)}, separators=(",", ":"),
-        ).encode() + height.to_bytes(8, "big")
-        return hashlib.sha256(blob).digest()
+        return merkle.hash_from_byte_slices(
+            self._state_leaves(state, height))
 
     @staticmethod
     def is_validator_tx(tx: bytes) -> bool:
@@ -115,8 +142,17 @@ class KVStoreApplication(BaseApplication):
                                      validator_updates=updates,
                                      app_hash=app_hash)
 
+    @property
+    def prev_state(self) -> dict | None:
+        return self._prev[0] if self._prev else None
+
+    @property
+    def prev_height(self) -> int:
+        return self._prev[1] if self._prev else 0
+
     def commit(self) -> ResponseCommit:
         if self.staged is not None:
+            self._prev = (self.state, self.last_height - 1)
             self.state = self.staged
             self.staged = None
         return ResponseCommit(retain_height=0)
@@ -126,6 +162,32 @@ class KVStoreApplication(BaseApplication):
             v = self.state.get(data.decode(errors="replace"))
             return CODE_TYPE_OK, (v.encode() if v is not None else b"")
         return 1, b"unknown path"
+
+    def query_prove(self, path: str, data: bytes
+                    ) -> Tuple[int, bytes, int, Optional[merkle.Proof]]:
+        """(code, value, height, inclusion proof) answered from the
+        previous committed snapshot, whose app hash is already inside a
+        stored header — the proof verifies against
+        header(height+1).app_hash (the reference's light/rpc client
+        checks query proofs at exactly that offset)."""
+        # snapshot once: commit() on the consensus thread swaps the
+        # snapshot concurrently with RPC-thread queries
+        prev = self._prev
+        prev_state, prev_height = prev if prev else (None, 0)
+        if prev_state is None or path not in ("/store", ""):
+            code, value = self.query(path, data)
+            return code, value, self.last_height, None
+        key = data.decode(errors="replace")
+        v = prev_state.get(key)
+        if v is None or key.encode() != data:
+            # second clause: a lossily-decoded (invalid UTF-8) query can
+            # alias a stored key; its leaf bytes would not match `data`
+            return CODE_TYPE_OK, b"", prev_height, None
+        value = v.encode()
+        leaves = self._state_leaves(prev_state, prev_height)
+        idx = leaves.index(self.kv_leaf(data, value))
+        _root, proofs = merkle.proofs_from_byte_slices(leaves)
+        return CODE_TYPE_OK, value, prev_height, proofs[idx]
 
     # --- statesync snapshots (reference kvstore.go snapshot support) ---------
 
@@ -196,5 +258,6 @@ class KVStoreApplication(BaseApplication):
         self.state = state
         self.last_height = height
         self.last_app_hash = r["app_hash"]
+        self._prev = None  # pre-restore snapshot no longer provable
         self._restore = None
         return "COMPLETE"

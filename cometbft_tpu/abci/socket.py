@@ -35,6 +35,7 @@ _M_COMMIT = 6
 _M_QUERY = 7
 _M_INIT_CHAIN = 8
 _M_FLUSH = 9
+_M_QUERY_PROVE = 10
 
 
 def _send_msg(sock, method: int, body: dict) -> None:
@@ -172,6 +173,15 @@ class ABCIServer:
         if method == _M_QUERY:
             code, value = app.query(b["path"], _unhx(b["data"]))
             return {"code": code, "value": _hx(value)}
+        if method == _M_QUERY_PROVE:
+            code, value, height, pf = app.query_prove(
+                b["path"], _unhx(b["data"]))
+            out = {"code": code, "value": _hx(value), "height": height}
+            if pf is not None:
+                out["proof"] = {"total": pf.total, "index": pf.index,
+                                "leaf_hash": _hx(pf.leaf_hash),
+                                "aunts": [_hx(a) for a in pf.aunts]}
+            return out
         raise ValueError(f"unknown ABCI method {method}")
 
     def stop(self) -> None:
@@ -261,6 +271,14 @@ class SocketClient:
     def query(self, path: str, data: bytes):
         r = self._call(_M_QUERY, {"path": path, "data": _hx(data)})
         return r["code"], _unhx(r["value"])
+
+    def query_prove(self, path: str, data: bytes):
+        from ..crypto.merkle import Proof
+        r = self._call(_M_QUERY_PROVE, {"path": path, "data": _hx(data)})
+        pf = r.get("proof")
+        proof = Proof(pf["total"], pf["index"], _unhx(pf["leaf_hash"]),
+                      [_unhx(a) for a in pf["aunts"]]) if pf else None
+        return r["code"], _unhx(r["value"]), r["height"], proof
 
     def close(self) -> None:
         try:

@@ -20,6 +20,8 @@ import os
 import shutil
 import sys
 
+from ..types.proto import Timestamp
+
 
 def _cfg(home: str):
     from ..config import Config
@@ -49,6 +51,7 @@ def cmd_init(args) -> int:
     if not os.path.exists(gen_path):
         save_genesis(GenesisDoc(
             chain_id=cfg.base.chain_id,
+            genesis_time=Timestamp.now(),
             validators=[Validator(pv.get_pub_key(), 10)]), gen_path)
     print(f"initialized node home at {home}")
     return 0
@@ -65,6 +68,9 @@ def cmd_start(args) -> int:
         cfg.rpc.laddr = args.rpc_laddr
     if args.persistent_peers:
         cfg.p2p.persistent_peers = args.persistent_peers
+    import faulthandler
+    import signal as _signal
+    faulthandler.register(_signal.SIGUSR1)  # live thread dump for hangs
     node = Node(cfg, KVStoreApplication())
     node.consensus.on_commit = lambda block, commit: print(
         f"committed height={block.header.height} "
@@ -114,6 +120,7 @@ def cmd_testnet(args) -> int:
         vals.append(Validator(pv.get_pub_key(), 10))
     order = sorted(range(n), key=lambda i: vals[i].address)
     gen = GenesisDoc(chain_id=args.chain_id,
+                     genesis_time=Timestamp.now(),
                      validators=[vals[i] for i in order])
     for i in range(n):
         save_genesis(gen, os.path.join(args.o, f"node{i}",
@@ -205,6 +212,50 @@ def cmd_compact(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """Run a light-client proxy against a full node (reference
+    cmd/cometbft/commands/light.go): all reads served from --laddr are
+    verified against light-client-checked headers."""
+    from ..db.kv import MemDB
+    from ..light.client import LightClient, TrustOptions
+    from ..light.provider import HTTPProvider
+    from ..light.rpc import LightProxy, VerifyingClient
+    from ..light.store import LightStore
+    from ..rpc.client import RPCClient
+
+    host, _, port = args.primary.rpartition(":")
+    primary = RPCClient(host or "127.0.0.1", int(port))
+    if args.trusted_height:
+        t_height, t_hash = args.trusted_height, bytes.fromhex(
+            args.trusted_hash)
+    else:  # trust-on-first-use from the primary (explicitly insecure)
+        st = primary.status()
+        t_height = st["sync_info"]["latest_block_height"]
+        t_hash = bytes.fromhex(st["sync_info"]["latest_block_hash"])
+    light = LightClient(
+        args.chain_id, TrustOptions(args.trust_period, t_height, t_hash),
+        HTTPProvider(args.chain_id, primary),
+        [HTTPProvider(args.chain_id, RPCClient(
+            h.rpartition(":")[0] or "127.0.0.1",
+            int(h.rpartition(":")[2])))
+         for h in args.witnesses.split(",") if h],
+        LightStore(MemDB()))
+    lhost, _, lport = args.laddr.rpartition(":")
+    proxy = LightProxy(VerifyingClient(light, primary),
+                       lhost or "127.0.0.1", int(lport or 0))
+    proxy.start()
+    print(f"light proxy listening on {proxy.addr} "
+          f"(primary {args.primary}, trusted height {t_height})",
+          flush=True)
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        proxy.stop()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cometbft_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -234,6 +285,19 @@ def build_parser() -> argparse.ArgumentParser:
     add("show-validator", cmd_show_validator)
     add("inspect", cmd_inspect)
     add("compact", cmd_compact)
+    lt = sub.add_parser("light")
+    lt.add_argument("chain_id")
+    lt.add_argument("--primary", required=True,
+                    help="host:port of the full node to proxy")
+    lt.add_argument("--witnesses", default="",
+                    help="comma-separated host:port cross-check nodes")
+    lt.add_argument("--laddr", default="127.0.0.1:0")
+    lt.add_argument("--trusted-height", dest="trusted_height", type=int,
+                    default=0)
+    lt.add_argument("--trusted-hash", dest="trusted_hash", default="")
+    lt.add_argument("--trust-period", dest="trust_period", type=int,
+                    default=168 * 3600)
+    lt.set_defaults(fn=cmd_light)
     return p
 
 

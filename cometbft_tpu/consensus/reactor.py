@@ -19,7 +19,7 @@ from typing import List
 from ..p2p.mconn import ChannelDescriptor
 from ..types import proto
 from ..types.block import Commit, Part
-from ..types.vote import Vote, PRECOMMIT_TYPE
+from ..types.vote import Vote, PRECOMMIT_TYPE, PREVOTE_TYPE
 from .state import (BlockPartMessage, ConsensusState, Message,
                     ProposalMessage, VoteMessage)
 from .wal import _decode_proposal, _encode_proposal
@@ -90,6 +90,8 @@ class ConsensusReactor:
         # keeps a stuck peer's once-per-round nil votes from triggering
         # a full commit+parts resend each time
         self._catchup_sent: dict = {}
+        # (peer_id, height) -> count of precommits seen at height-1
+        self._precommit_strikes: dict = {}
 
     def attach(self, switch) -> None:
         self._switch = switch
@@ -145,6 +147,23 @@ class ConsensusReactor:
         store = cs.block_store
         if h >= cs.rs.height or store is None:
             return
+        # precommits for the height just below ours are ROUTINE: after we
+        # finalize H and advance to H+1, the stragglers' precommits for H
+        # arrive moments later — resending the whole block for each would
+        # double steady-state bandwidth. A genuine laggard at H keeps
+        # emitting votes for H: prevotes while cycling rounds (trigger
+        # immediately), and a node parked in the commit step re-sends a
+        # vote every ~500ms via its commit-retry timer — so REPEATED
+        # precommits from one peer for the same old height (a straggler
+        # sends each vote once) are the other trigger.
+        if h == cs.rs.height - 1 and vote.type_ != PREVOTE_TYPE:
+            if len(self._precommit_strikes) > 4096:
+                self._precommit_strikes.clear()
+            key = (peer.id, h)
+            strikes = self._precommit_strikes.get(key, 0) + 1
+            self._precommit_strikes[key] = strikes
+            if strikes < 3:
+                return
         if not (store.base() <= h <= store.height()):
             return
         now = time.monotonic()

@@ -611,22 +611,29 @@ class ConsensusState:
 
     def _commit_retry(self) -> None:
         """Still in STEP_COMMIT with an incomplete decided block:
-        re-broadcast a precommit for this height (peers answer votes for
+        re-broadcast a vote for this height (peers answer votes for
         below-tip heights with the full commit + parts — the catch-up
-        path in consensus/reactor.py) and re-arm."""
+        path in consensus/reactor.py) and re-arm. A PREVOTE is
+        preferred: peers ignore stale precommits for the height right
+        below their tip (those are routine straggler votes), but a
+        prevote there marks a genuinely stuck node."""
         rs = self.rs
         if rs.step != STEP_COMMIT or rs.proposal_block is not None:
             return
-        vs = rs.votes.precommits(rs.commit_round)
         vote = None
+        own_idx = None
         if self._priv_pubkey is not None:
-            idx, _ = self.state.validators.get_by_address(
+            own_idx, _ = self.state.validators.get_by_address(
                 self._priv_pubkey.address())
-            if idx is not None and idx >= 0:
-                vote = vs.get_by_index(idx)
-        if vote is None:
-            votes = vs.list_votes()
-            vote = votes[0] if votes else None
+        for vs in (rs.votes.prevotes(rs.commit_round),
+                   rs.votes.precommits(rs.commit_round)):
+            if own_idx is not None and own_idx >= 0:
+                vote = vs.get_by_index(own_idx)
+            if vote is None:
+                votes = vs.list_votes()
+                vote = votes[0] if votes else None
+            if vote is not None:
+                break
         if vote is not None and not self._replaying:
             self.broadcast(VoteMessage(vote))
         self._schedule_commit_retry()

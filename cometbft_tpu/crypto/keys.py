@@ -172,3 +172,17 @@ class Ed25519BatchVerifier:
                            batch_size=self._batch_size)
         oks = [bool(v) for v in out]
         return all(oks), oks
+
+
+def pubkey_from_type_bytes(key_type: str, raw: bytes) -> PubKey:
+    """Key factory by wire type string (reference
+    crypto/encoding/codec.go:119 PubKeyFromTypeAndBytes)."""
+    if key_type == ED25519_KEY_TYPE:
+        return Ed25519PubKey(raw)
+    if key_type == "secp256k1":
+        from .secp256k1 import Secp256k1PubKey
+        return Secp256k1PubKey(raw)
+    if key_type == "sr25519":
+        from .sr25519 import Sr25519PubKey
+        return Sr25519PubKey(raw)
+    raise ValueError(f"unknown key type {key_type!r}")

@@ -50,3 +50,33 @@ class BlockStoreProvider:
             raise ErrLightBlockNotFound(
                 f"no light block at height {height}")
         return LightBlock(SignedHeader(blk.header, commit), vals)
+
+
+class HTTPProvider:
+    """Light blocks over a full node's JSON-RPC (reference
+    light/provider/http/http.go): /commit gives the signed header,
+    /validators the matching set; LightBlock.validate_basic binds them
+    via the header's validators_hash."""
+
+    def __init__(self, chain_id: str, rpc_client):
+        self._chain_id = chain_id
+        self._rpc = rpc_client
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        from ..rpc.client import RPCClientError
+        from ..rpc.codec import (commit_from_json, header_from_json,
+                                 validator_set_from_json)
+        try:
+            c = self._rpc.commit(height if height else None)
+            sh = SignedHeader(
+                header_from_json(c["signed_header"]["header"]),
+                commit_from_json(c["signed_header"]["commit"]))
+            vals = validator_set_from_json(
+                self._rpc.validators(sh.height))
+        except (RPCClientError, OSError, KeyError, ValueError) as e:
+            raise ErrLightBlockNotFound(
+                f"height {height}: {e}") from e
+        return LightBlock(sh, vals)

@@ -1,0 +1,180 @@
+"""Light RPC proxy: a JSON-RPC endpoint whose answers are verified
+against light-client-checked headers before being returned (reference
+light/rpc/client.go Client + light/proxy/proxy.go Proxy).
+
+The verifying client forwards reads to a full node and proves them:
+- `abci_query` demands a merkle proof and checks it against the
+  light-verified app hash (header at query-height+1 — the app hash in a
+  header is the result of executing the PREVIOUS block, reference
+  light/rpc/client.go ABCIQueryWithOptions);
+- `block` / `commit` / `header` check the primary's bytes hash to the
+  light-verified header for that height;
+- `validators` must hash to the verified header's validators_hash.
+
+The proof leaf contract for `abci_query` is the injective
+`0x01 || len(key)_u32be || key || value` form of
+`KVStoreApplication.kv_leaf`; apps with provable state expose the same
+shape (the reference's analog is its registered merkle ProofRuntime op
+set).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..crypto.merkle import Proof
+from ..rpc.client import RPCClient
+from ..rpc.codec import (commit_from_json, header_from_json,
+                         proof_from_json, validator_set_from_json)
+from ..rpc.server import RPCError, RPCServer
+from .client import LightClient
+
+
+class VerificationFailed(Exception):
+    pass
+
+
+class VerifyingClient:
+    """reference light/rpc/client.go Client."""
+
+    def __init__(self, light: LightClient, primary: RPCClient):
+        self.light = light
+        self.primary = primary
+
+    # --- verified reads -------------------------------------------------------
+
+    def abci_query(self, path: str, data: bytes) -> Dict:
+        r = self.primary.abci_query_prove(path, data)
+        if r.get("code", 0) != 0:
+            return r
+        value = bytes.fromhex(r.get("value", ""))
+        height = int(r.get("height", 0))
+        proof = proof_from_json(r.get("proof"))
+        if not value:
+            return r  # absence is not proven by this app (no range proofs)
+        if proof is None or height <= 0:
+            raise VerificationFailed("primary returned no proof")
+        lb = self.light.verify_light_block_at_height(height + 1)
+        from ..abci.kvstore import KVStoreApplication
+        leaf = KVStoreApplication.kv_leaf(data, value)
+        if not proof.verify(lb.header.app_hash, leaf):
+            raise VerificationFailed(
+                f"query proof does not match app hash at {height + 1}")
+        return r
+
+    def block(self, height: Optional[int] = None) -> Dict:
+        r = self.primary.block(height)
+        hdr = header_from_json(r["block"]["header"])
+        lb = self.light.verify_light_block_at_height(hdr.height)
+        if hdr.hash() != lb.header.hash():
+            raise VerificationFailed(
+                f"primary block header at {hdr.height} does not match "
+                f"verified header")
+        if bytes.fromhex(r["block_id"]["hash"]) != lb.header.hash():
+            raise VerificationFailed("primary block_id mismatch")
+        # the header hash only pins the header; the tx list must hash to
+        # its data_hash or the primary can attach forged transactions
+        from ..types.block import Data
+        txs = [bytes.fromhex(t) for t in r["block"]["data"]["txs"]]
+        if Data(txs).hash() != lb.header.data_hash:
+            raise VerificationFailed(
+                "primary block txs do not hash to the verified data_hash")
+        return r
+
+    def header(self, height: Optional[int] = None) -> Dict:
+        r = self.primary.header(height)
+        hdr = header_from_json(r["header"])
+        lb = self.light.verify_light_block_at_height(hdr.height)
+        if hdr.hash() != lb.header.hash():
+            raise VerificationFailed("header mismatch")
+        return r
+
+    def commit(self, height: Optional[int] = None) -> Dict:
+        r = self.primary.commit(height)
+        sh = r["signed_header"]
+        hdr = header_from_json(sh["header"])
+        commit = commit_from_json(sh["commit"])
+        lb = self.light.verify_light_block_at_height(hdr.height)
+        if hdr.hash() != lb.header.hash():
+            raise VerificationFailed("commit header mismatch")
+        if commit.block_id.hash != lb.header.hash():
+            raise VerificationFailed("commit is for a different block")
+        # a consumer uses this as a signed-header source, so the
+        # signatures themselves must carry 2/3 of the verified set —
+        # block-id equality alone would relay forged signature lists
+        from ..types import validation
+        try:
+            validation.verify_commit_light(
+                self.light.chain_id, lb.validator_set, commit.block_id,
+                hdr.height, commit)
+        except Exception as e:  # noqa: BLE001 — any verify error
+            raise VerificationFailed(f"commit signatures invalid: {e}")
+        return r
+
+    def validators(self, height: Optional[int] = None) -> Dict:
+        r = self.primary.call("validators", **(
+            {} if height is None else {"height": height}))
+        vals = validator_set_from_json(r)
+        h = int(r.get("block_height", 0))
+        if h <= 0:
+            raise VerificationFailed("primary omitted block_height")
+        lb = self.light.verify_light_block_at_height(h)
+        if vals.hash() != lb.header.validators_hash:
+            raise VerificationFailed(
+                "primary validators do not hash to verified header")
+        return r
+
+    # --- passthroughs (unverifiable by nature) -------------------------------
+
+    def status(self) -> Dict:
+        return self.primary.status()
+
+    def broadcast_tx_sync(self, tx: bytes) -> Dict:
+        return self.primary.broadcast_tx_sync(tx)
+
+
+class LightProxy:
+    """reference light/proxy/proxy.go: the verifying client served back
+    out as a JSON-RPC endpoint (same server conventions as rpc/server)."""
+
+    def __init__(self, client: VerifyingClient, host: str = "127.0.0.1",
+                 port: int = 0):
+        c = client
+
+        def _wrap(fn):
+            def call(**kw):
+                try:
+                    return fn(**kw)
+                except VerificationFailed as e:
+                    raise RPCError(-32001, f"verification failed: {e}")
+            return call
+
+        methods = {
+            "health": lambda: {},
+            "status": _wrap(lambda: c.status()),
+            "abci_query": _wrap(
+                lambda path="", data="", prove=True:
+                c.abci_query(path, bytes.fromhex(data))),
+            "block": _wrap(
+                lambda height=None: c.block(_h(height))),
+            "header": _wrap(
+                lambda height=None: c.header(_h(height))),
+            "commit": _wrap(
+                lambda height=None: c.commit(_h(height))),
+            "validators": _wrap(
+                lambda height=None: c.validators(_h(height))),
+            "broadcast_tx_sync": _wrap(
+                lambda tx="": c.broadcast_tx_sync(bytes.fromhex(tx))),
+        }
+        self._server = RPCServer(None, host, port, methods=methods)
+        self.addr = self._server.addr
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+def _h(height) -> Optional[int]:
+    return None if height is None else int(height)

@@ -42,6 +42,18 @@ class RPCClient:
     def broadcast_tx_sync(self, tx: bytes) -> Dict:
         return self.call("broadcast_tx_sync", tx=tx.hex())
 
+    def commit(self, height: Optional[int] = None) -> Dict:
+        return self.call("commit", **(
+            {} if height is None else {"height": height}))
+
+    def header(self, height: Optional[int] = None) -> Dict:
+        return self.call("header", **(
+            {} if height is None else {"height": height}))
+
+    def abci_query_prove(self, path: str, data: bytes) -> Dict:
+        return self.call("abci_query", path=path, data=data.hex(),
+                         prove=True)
+
     def abci_query(self, path: str, data: bytes) -> Dict:
         return self.call("abci_query", path=path, data=data.hex())
 

@@ -52,27 +52,9 @@ class RPCEnvironment:
             (lambda: consensus.state) if consensus else (lambda: None))
 
 
-def _block_id_json(bid) -> dict:
-    return {"hash": bid.hash.hex(),
-            "parts": {"total": bid.parts.total,
-                      "hash": bid.parts.hash.hex()}}
-
-
-def _header_json(h) -> dict:
-    return {
-        "chain_id": h.chain_id, "height": h.height,
-        "time": [h.time.seconds, h.time.nanos],
-        "last_block_id": _block_id_json(h.last_block_id),
-        "last_commit_hash": h.last_commit_hash.hex(),
-        "data_hash": h.data_hash.hex(),
-        "validators_hash": h.validators_hash.hex(),
-        "next_validators_hash": h.next_validators_hash.hex(),
-        "consensus_hash": h.consensus_hash.hex(),
-        "app_hash": h.app_hash.hex(),
-        "last_results_hash": h.last_results_hash.hex(),
-        "evidence_hash": h.evidence_hash.hex(),
-        "proposer_address": h.proposer_address.hex(),
-    }
+from .codec import (block_id_json as _block_id_json,
+                    header_json as _header_json, commit_json,
+                    proof_json, validator_set_json)
 
 
 class Routes:
@@ -151,15 +133,25 @@ class Routes:
         return {"last_height": top, "block_metas": metas}
 
     def commit(self, height=None) -> dict:
+        """Full signed header (reference rpc/core/blocks.go Commit):
+        the canonical commit when block h+1 is stored, else the seen
+        commit — enough for a light client to reconstruct and verify."""
         h = self._height_or_latest(height)
+        hdr = self.env.block_store.load_block(h).header
         c = self.env.block_store.load_block_commit(h)
+        canonical = c is not None
         if c is None:
             c = self.env.block_store.load_seen_commit(h)
         if c is None:
             raise RPCError(-32603, f"no commit for height {h}")
-        return {"height": c.height, "round": c.round,
-                "block_id": _block_id_json(c.block_id),
-                "signatures": len(c.signatures)}
+        return {"signed_header": {"header": _header_json(hdr),
+                                  "commit": commit_json(c)},
+                "canonical": canonical}
+
+    def header(self, height=None) -> dict:
+        h = self._height_or_latest(height)
+        blk = self.env.block_store.load_block(h)
+        return {"header": _header_json(blk.header)}
 
     def validators(self, height=None) -> dict:
         h = self._height_or_latest(height)
@@ -167,13 +159,7 @@ class Routes:
                 if self.env.state_store else None)
         if vals is None:
             raise RPCError(-32603, f"no validator set at height {h}")
-        return {"block_height": h,
-                "validators": [
-                    {"address": v.address.hex(),
-                     "pub_key": v.pub_key.bytes_().hex(),
-                     "voting_power": v.voting_power,
-                     "proposer_priority": v.proposer_priority}
-                    for v in vals.validators]}
+        return {"block_height": h, **validator_set_json(vals)}
 
     # --- ABCI ----------------------------------------------------------------
 
@@ -183,7 +169,16 @@ class Routes:
                 "last_block_height": info.last_block_height,
                 "last_block_app_hash": info.last_block_app_hash.hex()}
 
-    def abci_query(self, path="", data="") -> dict:
+    def abci_query(self, path="", data="", prove=False) -> dict:
+        if isinstance(prove, str):  # GET query-string form
+            prove = prove.lower() in ("1", "true", "yes")
+        if prove:
+            code, value, height, pf = self.env.app_query.query_prove(
+                path, bytes.fromhex(data))
+            out = {"code": code, "value": value.hex(), "height": height}
+            if pf is not None:
+                out["proof"] = proof_json(pf)
+            return out
         code, value = self.env.app_query.query(path, bytes.fromhex(data))
         return {"code": code, "value": value.hex()}
 
@@ -268,16 +263,21 @@ class Routes:
 
 
 class RPCServer:
-    def __init__(self, env: RPCEnvironment, host: str = "127.0.0.1",
-                 port: int = 0):
-        routes = Routes(env)
-        methods: Dict[str, Callable] = {
-            name: getattr(routes, name) for name in (
-                "health", "status", "net_info", "genesis", "block",
-                "blockchain", "commit", "validators", "abci_info",
-                "abci_query", "broadcast_tx_sync", "broadcast_tx_async",
-                "unconfirmed_txs", "tx", "tx_search", "block_search",
-                "wait_event")}
+    def __init__(self, env: Optional[RPCEnvironment],
+                 host: str = "127.0.0.1", port: int = 0,
+                 methods: Optional[Dict[str, Callable]] = None):
+        """Default: the full route map over `env`. A custom `methods`
+        dict serves the same JSON-RPC conventions over other backends
+        (the light proxy reuses this server with verified routes)."""
+        if methods is None:
+            routes = Routes(env)
+            methods = {
+                name: getattr(routes, name) for name in (
+                    "health", "status", "net_info", "genesis", "block",
+                    "blockchain", "commit", "header", "validators",
+                    "abci_info", "abci_query", "broadcast_tx_sync",
+                    "broadcast_tx_async", "unconfirmed_txs", "tx",
+                    "tx_search", "block_search", "wait_event")}
 
         class Handler(BaseHTTPRequestHandler):
             # RFC 6455 requires the 101 on HTTP/1.1 (clients reject a
@@ -331,6 +331,7 @@ class RPCServer:
                     from .websocket import (is_websocket_upgrade,
                                             serve_websocket)
                     if is_websocket_upgrade(self.headers) and \
+                            env is not None and \
                             env.event_bus is not None:
                         serve_websocket(self, env.event_bus)
                         self.close_connection = True

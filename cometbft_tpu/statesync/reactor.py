@@ -143,7 +143,16 @@ class StatesyncNetReactor:
             missing = proto.field_int(f, 5, 0)
             chunk = None if missing else proto.field_bytes(f, 4, b"")
             with self._lock:
-                futs = self._pending_chunks.pop(key, [])
+                # only resolve futures whose request went to THIS peer —
+                # peer A's late (or 'missing') response must not consume
+                # a retry already re-issued to peer B
+                entry = self._pending_chunks.get(key, [])
+                futs = [(p, f) for p, f in entry if p == peer.id]
+                rest = [(p, f) for p, f in entry if p != peer.id]
+                if rest:
+                    self._pending_chunks[key] = rest
+                else:
+                    self._pending_chunks.pop(key, None)
             for _pid, fut in futs:
                 if not fut.done():
                     fut.set_result(chunk)

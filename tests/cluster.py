@@ -32,12 +32,15 @@ def make_genesis(n_vals: int, chain_id: str = "tpu-cluster",
     """n FilePVs + a GenesisDoc giving each equal power."""
     import random
     rng = random.Random(seed)
+    from cometbft_tpu.types.proto import Timestamp
     pvs = [FilePV.generate(None, rng) for _ in range(n_vals)]
     vals = [Validator(pv.get_pub_key(), power) for pv in pvs]
     # deterministic ordering (reference sorts validator sets by address)
     order = sorted(range(n_vals), key=lambda i: vals[i].address)
     return ([pvs[i] for i in order],
-            GenesisDoc(chain_id=chain_id, validators=[vals[i] for i in order]))
+            GenesisDoc(chain_id=chain_id,
+                       genesis_time=Timestamp.now(),
+                       validators=[vals[i] for i in order]))
 
 
 class Node:
@@ -56,6 +59,10 @@ class Node:
         self.evidence_pool = EvidencePool(
             state_store=self.state_store, block_store=self.block_store)
         state = State.from_genesis(gen)
+        # bootstrap-save so the genesis validator set is indexed at the
+        # initial height (reference state/store.go Bootstrap; node.py
+        # does the same) — light clients look up vals:1
+        self.state_store.save(state)
         self.executor = BlockExecutor(
             self.app, state_store=self.state_store,
             block_store=self.block_store, mempool=self.mempool,
