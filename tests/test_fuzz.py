@@ -1,0 +1,209 @@
+"""Deterministic fuzz tests over the attacker-facing decode surfaces
+(reference test/fuzz/: mempool CheckTx, p2p SecretConnection, rpc
+jsonrpc server; plus this repo's hand-rolled proto layer, which is the
+equivalent of the reference's generated-proto unmarshal surface).
+
+Python has no native go-fuzz; seeded random corpora approximate it the
+way the reference's fuzz targets run fixed corpora in CI. The invariant
+everywhere: garbage MUST surface as a clean error (ValueError & co.),
+never a crash class (AssertionError from internals, IndexError,
+KeyError, TypeError, AttributeError, MemoryError) or a hang.
+"""
+
+import json
+import secrets
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.types import proto
+from cometbft_tpu.types.block import Block, Commit, Part
+from cometbft_tpu.types.vote import Proposal, Vote
+
+DECODE_OK_ERRORS = (ValueError, proto.WireError) \
+    if hasattr(proto, "WireError") else (ValueError,)
+
+
+def _rng(seed=0xC0FFEE):
+    return np.random.default_rng(seed)
+
+
+def _mutations(rng, base: bytes, n: int):
+    """Random blobs + structured mutations of a valid encoding — the
+    mix go-fuzz converges to."""
+    for _ in range(n):
+        kind = rng.integers(0, 3)
+        if kind == 0 or not base:
+            yield rng.integers(0, 256, size=int(rng.integers(0, 200)),
+                               dtype=np.uint8).tobytes()
+        elif kind == 1:  # flip bytes
+            buf = bytearray(base)
+            for _ in range(int(rng.integers(1, 8))):
+                buf[int(rng.integers(0, len(buf)))] = int(
+                    rng.integers(0, 256))
+            yield bytes(buf)
+        else:  # truncate / extend
+            cut = int(rng.integers(0, len(base) + 1))
+            yield base[:cut] + rng.integers(
+                0, 256, size=int(rng.integers(0, 16)),
+                dtype=np.uint8).tobytes()
+
+
+def test_fuzz_proto_parse_fields():
+    rng = _rng(1)
+    base = (proto.f_varint(1, 7) + proto.f_bytes(2, b"xy")
+            + proto.f_embed(3, proto.f_varint(1, 1)))
+    for blob in _mutations(rng, base, 400):
+        try:
+            proto.parse_fields(blob)
+        except DECODE_OK_ERRORS:
+            pass
+
+
+@pytest.mark.parametrize("decoder", [
+    Block.decode, Commit.decode, Vote.decode, Part.decode,
+], ids=["block", "commit", "vote", "part"])
+def test_fuzz_type_decoders(decoder):
+    """Structured mutations of real encodings through every consensus
+    decoder; gossip feeds these bytes straight off the wire."""
+    from cluster import make_genesis
+    from cometbft_tpu.engine.chain_gen import generate_chain
+
+    chain = generate_chain(n_blocks=2, n_validators=4, seed=3)
+    block = chain.blocks[1]
+    bases = {
+        Block.decode: block.encode(),
+        Commit.decode: block.last_commit.encode(),
+        Vote.decode: Vote(type_=1, height=1, round=0,
+                          validator_address=b"\x07" * 20,
+                          validator_index=0,
+                          signature=b"\x01" * 64).encode(),
+        Part.decode: block.make_part_set().parts[0].encode(),
+    }
+    rng = _rng(int(bases[decoder][0]) + 11)
+    for blob in _mutations(rng, bases[decoder], 250):
+        try:
+            decoder(blob)
+        except DECODE_OK_ERRORS:
+            pass
+
+
+def test_fuzz_wal_replay(tmp_path):
+    """Torn/corrupted WAL tails must truncate or error cleanly, never
+    crash replay (reference consensus/wal_test.go corruption cases)."""
+    from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+    rng = _rng(5)
+    path = tmp_path / "wal"
+    w = WAL(str(path))
+    w.write_sync(EndHeightMessage(1))
+    base = path.read_bytes()
+    for i, blob in enumerate(_mutations(rng, base, 60)):
+        p = tmp_path / f"wal{i}"
+        p.write_bytes(blob)
+        try:
+            WAL(str(p)).replay_messages(1)
+        except DECODE_OK_ERRORS:
+            pass
+
+
+def test_fuzz_mempool_check_tx():
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.mempool.mempool import CListMempool
+    app = KVStoreApplication()
+    mp = CListMempool(lambda tx: (app.check_tx(tx).code, 0))
+    rng = _rng(7)
+    for blob in _mutations(rng, b"key=value", 300):
+        try:
+            mp.check_tx(blob)
+        except ValueError:
+            pass  # oversized / duplicate — the defined error surface
+
+
+def test_fuzz_secret_connection_frames():
+    """Corrupted ciphertext frames must kill the connection with a clean
+    error — never hang or crash (reference test/fuzz/p2p/secretconnection).
+    """
+    from cometbft_tpu.p2p.conn import SecretConnection, HandshakeError
+
+    a_sock, b_sock = socket.socketpair()
+    a_sock.settimeout(10)
+    b_sock.settimeout(10)
+    result = {}
+
+    def accept_side():
+        try:
+            result["conn"] = SecretConnection(
+                b_sock, Ed25519PrivKey.generate())
+        except Exception as e:  # noqa: BLE001
+            result["err"] = e
+
+    t = threading.Thread(target=accept_side, daemon=True)
+    t.start()
+    sc = SecretConnection(a_sock, Ed25519PrivKey.generate())
+    t.join(timeout=10)
+    peer = result["conn"]
+
+    sc.send_message(b"hello")
+    assert peer.recv_message() == b"hello"
+
+    # corrupt a frame on the raw socket: the AEAD must reject it
+    a_sock.sendall(secrets.token_bytes(64))
+    with pytest.raises(Exception) as exc_info:
+        peer.recv_message()
+    assert not isinstance(exc_info.value,
+                          (AssertionError, KeyError, AttributeError))
+    sc.close()
+    peer.close()
+
+
+def test_fuzz_handshake_garbage():
+    """A peer speaking garbage during the handshake must fail cleanly."""
+    from cometbft_tpu.p2p.conn import SecretConnection, HandshakeError
+    rng = _rng(13)
+    for i in range(12):
+        a_sock, b_sock = socket.socketpair()
+        a_sock.settimeout(5)
+        b_sock.settimeout(5)
+
+        def garbage_side():
+            try:
+                b_sock.sendall(rng.integers(
+                    0, 256, size=int(rng.integers(1, 96)),
+                    dtype=np.uint8).tobytes())
+                b_sock.close()
+            except OSError:
+                pass
+
+        t = threading.Thread(target=garbage_side, daemon=True)
+        t.start()
+        with pytest.raises((HandshakeError, OSError, ValueError)):
+            SecretConnection(a_sock, Ed25519PrivKey.generate())
+        t.join(timeout=5)
+        a_sock.close()
+
+
+def test_fuzz_rpc_server_bodies():
+    """Malformed JSON-RPC requests get error responses, not hangs or 500
+    crash loops (reference test/fuzz/rpc/jsonrpc/server)."""
+    import urllib.request
+    from cometbft_tpu.rpc.server import RPCEnvironment, RPCServer
+
+    srv = RPCServer(RPCEnvironment(chain_id="fuzz"))
+    srv.start()
+    rng = _rng(17)
+    try:
+        url = f"http://127.0.0.1:{srv.addr[1]}/"
+        valid = json.dumps({"jsonrpc": "2.0", "method": "health",
+                            "params": {}, "id": 1}).encode()
+        for blob in _mutations(rng, valid, 60):
+            req = urllib.request.Request(url, data=blob, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    resp.read()
+            except OSError:
+                pass  # HTTP-level rejection is fine; hanging is not
+    finally:
+        srv.stop()

@@ -1,0 +1,162 @@
+"""Load-generation + latency measurement against a running node's RPC
+(reference test/loadtime/: tx generator with rate control + report
+aggregator; test/e2e/runner/benchmark.go:24: block-interval stats).
+
+Usage:
+    python tools/loadtime.py --rpc 127.0.0.1:26657 --rate 50 \
+        --duration 10 [--connections 2] [--json]
+
+Each tx embeds a send-timestamp nonce (the reference's loadtime payload
+carries the same); latency = commit-observation time - send time,
+measured by polling /tx until the hash is indexed. Prints a report with
+throughput, latency quantiles, and block-interval stats.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.rpc.client import RPCClient, RPCClientError  # noqa: E402
+from cometbft_tpu.types.block import tx_hash  # noqa: E402
+
+
+def generate_load(host: str, port: int, rate: float, duration: float,
+                  connections: int = 1) -> dict:
+    """Fire `rate` tx/s for `duration`s; return the raw send ledger."""
+    sent = []  # (hash, send_monotonic)
+    lock = threading.Lock()
+    stop_at = time.monotonic() + duration
+    interval = connections / rate
+
+    def worker(wid: int):
+        rpc = RPCClient(host, port, timeout=30)
+        next_send = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if now >= stop_at:
+                return
+            if now < next_send:
+                time.sleep(min(next_send - now, 0.05))
+                continue
+            next_send += interval
+            tx = (f"load-{wid}-".encode() + secrets.token_hex(8).encode()
+                  + b"=" + str(time.time_ns()).encode())
+            try:
+                r = rpc.broadcast_tx_sync(tx)
+            except (RPCClientError, OSError):
+                continue
+            if r.get("code", 1) == 0:
+                with lock:
+                    sent.append((tx_hash(tx), time.time()))
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(connections)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {"sent": sent}
+
+
+def await_commits(host: str, port: int, ledger: dict,
+                  timeout: float = 60.0) -> list:
+    """Poll the tx index until every sent tx is committed (or timeout);
+    returns [(latency_seconds, height)]. Latency = committed block's
+    header time - send wall time (the reference's loadtime report also
+    derives latency from block timestamps, not poll observation)."""
+    rpc = RPCClient(host, port, timeout=30)
+    latencies = []
+    pending = dict(ledger["sent"])
+    block_time: dict = {}
+    deadline = time.monotonic() + timeout
+    while pending and time.monotonic() < deadline:
+        for h, t0 in list(pending.items()):
+            try:
+                r = rpc.call("tx", hash=h.hex())
+                height = r["height"]
+                if height not in block_time:
+                    t = rpc.header(height)["header"]["time"]
+                    block_time[height] = t[0] + t[1] / 1e9
+            except (RPCClientError, OSError):
+                continue
+            latencies.append((max(block_time[height] - t0, 0.0), height))
+            del pending[h]
+        if pending:
+            time.sleep(0.1)
+    return latencies
+
+
+def block_interval_stats(host: str, port: int, heights) -> dict:
+    """reference test/e2e/runner/benchmark.go: block time deltas over
+    the load window."""
+    if not heights:
+        return {}
+    rpc = RPCClient(host, port, timeout=30)
+    lo, hi = min(heights), max(heights)
+    times = {}
+    for h in range(lo, hi + 1):
+        hd = rpc.header(h)["header"]
+        times[h] = hd["time"][0] + hd["time"][1] / 1e9
+    deltas = [times[h + 1] - times[h] for h in range(lo, hi)]
+    if not deltas:
+        return {"blocks": 1}
+    return {"blocks": hi - lo + 1,
+            "interval_avg_s": sum(deltas) / len(deltas),
+            "interval_max_s": max(deltas)}
+
+
+def quantile(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def run(host: str, port: int, rate: float, duration: float,
+        connections: int) -> dict:
+    t0 = time.monotonic()
+    ledger = generate_load(host, port, rate, duration, connections)
+    results = await_commits(host, port, ledger)
+    wall = time.monotonic() - t0
+    lats = [lat for lat, _h in results]
+    heights = [h for _lat, h in results]
+    return {
+        "txs_sent": len(ledger["sent"]),
+        "txs_committed": len(results),
+        "throughput_tx_s": round(len(results) / wall, 2) if wall else 0,
+        "latency_p50_s": round(quantile(lats, 0.50), 4),
+        "latency_p90_s": round(quantile(lats, 0.90), 4),
+        "latency_max_s": round(quantile(lats, 1.0), 4),
+        **block_interval_stats(host, port, heights),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rpc", default="127.0.0.1:26657")
+    ap.add_argument("--rate", type=float, default=20.0)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--connections", type=int, default=1)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    host, _, port = args.rpc.rpartition(":")
+    report = run(host or "127.0.0.1", int(port), args.rate,
+                 args.duration, args.connections)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for k, v in report.items():
+            print(f"{k:20s} {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
