@@ -8,7 +8,8 @@ Grammar (clean-start and crash-recovery forms):
 
     start            := clean_start | recovery
     clean_start      := init_chain state_sync? consensus_exec
-    state_sync       := offer_snapshot* success_sync
+    state_sync       := attempt* success_sync
+    attempt          := offer_snapshot apply_snapshot_chunk*
     success_sync     := offer_snapshot apply_snapshot_chunk+
     recovery         := consensus_exec
     consensus_exec   := consensus_height+
@@ -73,15 +74,21 @@ class _Parser:
                                "<end of execution>")
 
     def state_sync(self) -> None:
-        # zero or more rejected offers, then the accepted one + chunks
+        # attempts may abort mid-chunks; only the LAST attempt must
+        # complete with >=1 chunk (the success-sync). Greedy: consume
+        # every offer+chunks group, remember whether the final group
+        # applied anything.
+        last_had_chunks = False
         while self.peek() == "offer_snapshot":
             self.i += 1
-            if self.peek() == "apply_snapshot_chunk":
-                while self.peek() == "apply_snapshot_chunk":
-                    self.i += 1
-                return
-        raise GrammarError(self.i, self.peek() or "<end>",
-                           "apply_snapshot_chunk after accepted offer")
+            last_had_chunks = False
+            while self.peek() == "apply_snapshot_chunk":
+                self.i += 1
+                last_had_chunks = True
+        if not last_had_chunks:
+            raise GrammarError(self.i, self.peek() or "<end>",
+                               "apply_snapshot_chunk completing the "
+                               "final snapshot attempt")
 
     def consensus_exec(self) -> None:
         self.consensus_height()

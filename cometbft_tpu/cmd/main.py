@@ -71,6 +71,12 @@ def cmd_start(args) -> int:
     import faulthandler
     import signal as _signal
     faulthandler.register(_signal.SIGUSR1)  # live thread dump for hangs
+    # pin the platform + compile cache up front: a node whose verify
+    # batch crosses the device threshold mid-run must not initialize
+    # the backend from a consensus thread with ambient (possibly
+    # tunnel-pinned) platform config
+    from ..libs.jax_cache import enable_compile_cache
+    enable_compile_cache()
     node = Node(cfg, KVStoreApplication())
     node.consensus.on_commit = lambda block, commit: print(
         f"committed height={block.header.height} "
@@ -298,6 +304,15 @@ def build_parser() -> argparse.ArgumentParser:
     lt.add_argument("--trust-period", dest="trust_period", type=int,
                     default=168 * 3600)
     lt.set_defaults(fn=cmd_light)
+    dv = sub.add_parser("device-server")
+    dv.add_argument("--laddr", default="127.0.0.1:28657")
+    dv.add_argument("--bucket", type=int, default=1024)
+    dv.add_argument("--max-msg-len", dest="max_msg_len", type=int,
+                    default=256)
+    dv.set_defaults(fn=lambda args: __import__(
+        "cometbft_tpu.device.server", fromlist=["main"]).main(
+        ["--laddr", args.laddr, "--bucket", str(args.bucket),
+         "--max-msg-len", str(args.max_msg_len)]))
     return p
 
 

@@ -11,8 +11,17 @@ from .keys import BatchVerifier, Ed25519BatchVerifier, PubKey, ED25519_KEY_TYPE
 
 def create_batch_verifier(pk: PubKey) -> Tuple[Optional[BatchVerifier], bool]:
     """(verifier, supported) for the given key type
-    (reference crypto/batch/batch.go:11-21)."""
+    (reference crypto/batch/batch.go:11-21).
+
+    With COMETBFT_TPU_DEVICE_SERVER=host:port set, ed25519 batches are
+    shipped to the host's TPU-owner device server instead of verifying
+    in-process — every node process on the machine then shares one
+    compiled kernel and one accumulate-and-flush tile stream."""
     if pk.type_() == ED25519_KEY_TYPE:
+        from ..device.client import RemoteBatchVerifier, shared_client
+        client = shared_client()
+        if client is not None:
+            return RemoteBatchVerifier(client), True
         return Ed25519BatchVerifier(), True
     if pk.type_() == "sr25519":
         from .sr25519 import Sr25519BatchVerifier

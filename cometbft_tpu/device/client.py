@@ -1,0 +1,158 @@
+"""Python client for the verify device server, plus the BatchVerifier
+adapter that lets any node process offload signature verification to
+the host's single TPU-owner process (the plugin seam
+crypto/batch.CreateBatchVerifier selects by key type in the reference,
+crypto/batch/batch.go:11-21 — here selected by configuration).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .protocol import (decode_response, encode_request, recv_frame,
+                       send_frame)
+
+ENV_VAR = "COMETBFT_TPU_DEVICE_SERVER"  # host:port
+
+
+class DeviceUnprocessable(Exception):
+    """The server could not run this batch (oversized message / too
+    many lanes) — distinct from per-lane verification failure so the
+    caller verifies locally instead of blaming signatures."""
+
+
+class DeviceClient:
+    """Thread-safe: concurrent verify() calls multiplex one socket by
+    req_id (the MConnection-pattern request/response matching SURVEY
+    §5.8 calls for on the verify-offload queue)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._wlock = threading.Lock()
+        self._pending: Dict[int, threading.Event] = {}
+        self._results: Dict[int, Tuple[bool, List[bool]]] = {}
+        self._ids = itertools.count(1)
+        self._dead: Optional[Exception] = None
+        threading.Thread(target=self._recv_routine, daemon=True).start()
+
+    def _recv_routine(self) -> None:
+        try:
+            while True:
+                req_id, batch_ok, oks = decode_response(
+                    recv_frame(self._sock))
+                with self._wlock:
+                    ev = self._pending.pop(req_id, None)
+                    if ev is not None:  # drop answers nobody awaits
+                        self._results[req_id] = (batch_ok, oks)
+                if ev is not None:
+                    ev.set()
+        except (ConnectionError, OSError, ValueError) as e:
+            with self._wlock:
+                self._dead = e
+                for ev in self._pending.values():
+                    ev.set()
+                self._pending.clear()
+
+    def verify(self, pubs: List[bytes], msgs: List[bytes],
+               sigs: List[bytes], timeout: float = 120.0
+               ) -> Tuple[bool, List[bool]]:
+        if not pubs:
+            return False, []
+        req_id = next(self._ids)
+        ev = threading.Event()
+        with self._wlock:
+            if self._dead is not None:
+                raise ConnectionError(f"device link down: {self._dead}")
+            self._pending[req_id] = ev
+            send_frame(self._sock, encode_request(req_id, pubs, msgs,
+                                                  sigs))
+        if not ev.wait(timeout):
+            with self._wlock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError("device server did not answer")
+        with self._wlock:
+            if req_id not in self._results:
+                raise ConnectionError(
+                    f"device link down: {self._dead}")
+            batch_ok, oks = self._results.pop(req_id)
+        if len(oks) != len(pubs):
+            raise DeviceUnprocessable(
+                f"server answered {len(oks)} lanes for {len(pubs)}")
+        return batch_ok, oks
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+_shared: Optional[DeviceClient] = None
+_shared_lock = threading.Lock()
+
+
+def shared_client() -> Optional[DeviceClient]:
+    """Process-wide client to the address in COMETBFT_TPU_DEVICE_SERVER
+    (one socket per process; the server coalesces across processes).
+    A dead link is dropped so the next call can reconnect; connect uses
+    a short timeout — an unreachable server must not stall the
+    consensus-path caller, which falls back to in-process verification."""
+    global _shared
+    addr = os.environ.get(ENV_VAR, "")
+    if not addr:
+        return None
+    with _shared_lock:
+        if _shared is not None and _shared._dead is not None:
+            _shared.close()
+            _shared = None
+        if _shared is None:
+            host, _, port = addr.rpartition(":")
+            try:
+                _shared = DeviceClient(host or "127.0.0.1", int(port),
+                                       timeout=2.0)
+            except (OSError, ValueError):
+                return None
+        return _shared
+
+
+class RemoteBatchVerifier:
+    """crypto.BatchVerifier backed by the device server, with an
+    in-process fallback: a dead/slow/unwilling server degrades to local
+    verification — it must never surface transport errors (or worse,
+    false signature verdicts) into commit/vote verification."""
+
+    def __init__(self, client: DeviceClient):
+        self._client = client
+        self._pubs: List[bytes] = []
+        self._msgs: List[bytes] = []
+        self._sigs: List[bytes] = []
+
+    def __len__(self) -> int:
+        return len(self._pubs)
+
+    def add(self, pk, msg: bytes, sig: bytes) -> None:
+        self._pubs.append(pk.bytes_())
+        self._msgs.append(msg)
+        self._sigs.append(sig)
+
+    def _local(self) -> Tuple[bool, List[bool]]:
+        from ..crypto.keys import Ed25519BatchVerifier, Ed25519PubKey
+        bv = Ed25519BatchVerifier()
+        for p, m, s in zip(self._pubs, self._msgs, self._sigs):
+            bv.add(Ed25519PubKey(p), m, s)
+        return bv.verify()
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        if not self._pubs:
+            return False, []
+        try:
+            return self._client.verify(self._pubs, self._msgs,
+                                       self._sigs)
+        except (DeviceUnprocessable, ConnectionError, TimeoutError,
+                OSError):
+            return self._local()

@@ -1,0 +1,134 @@
+// C client shim for the verify device server — the binding a non-Python
+// engine links against to reach the TPU data plane (SURVEY §2.2: the
+// cgo-shim role in the reference's Go → native crypto boundary;
+// protocol documented in protocol.py).
+//
+// C ABI:
+//   void *dvc_connect(const char *host, int port);
+//   int   dvc_verify(void *h, uint32_t n,
+//                    const uint8_t *pubs,      // n × 32, packed
+//                    const uint8_t *sigs,      // n × 64, packed
+//                    const uint32_t *msg_lens, // n lengths
+//                    const uint8_t *msgs,      // concatenated bodies
+//                    uint8_t *out_ok);         // n verdicts out
+//         returns 1 if every lane verified, 0 if any failed, -1 on
+//         transport error
+//   void  dvc_close(void *h);
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -o devclient.so devclient.cc
+
+#include <arpa/inet.h>
+#include <cstdint>
+#include <cstring>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Conn {
+  int fd;
+  uint64_t next_id;
+};
+
+bool send_all(int fd, const uint8_t *p, size_t n) {
+  while (n) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, uint8_t *p, size_t n) {
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void put_u32(std::vector<uint8_t> &b, uint32_t v) {
+  for (int i = 0; i < 4; i++) b.push_back((v >> (8 * i)) & 0xff);
+}
+
+void put_u64(std::vector<uint8_t> &b, uint64_t v) {
+  for (int i = 0; i < 8; i++) b.push_back((v >> (8 * i)) & 0xff);
+}
+
+}  // namespace
+
+extern "C" {
+
+void *dvc_connect(const char *host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr *>(&addr),
+                sizeof(addr)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return new Conn{fd, 1};
+}
+
+int dvc_verify(void *h, uint32_t n, const uint8_t *pubs,
+               const uint8_t *sigs, const uint32_t *msg_lens,
+               const uint8_t *msgs, uint8_t *out_ok) {
+  if (h == nullptr || n == 0) return -1;
+  Conn *c = static_cast<Conn *>(h);
+  const uint64_t req_id = c->next_id++;
+
+  std::vector<uint8_t> payload;
+  payload.reserve(12 + n * 132);
+  put_u64(payload, req_id);
+  put_u32(payload, n);
+  const uint8_t *mp = msgs;
+  for (uint32_t i = 0; i < n; i++) {
+    payload.insert(payload.end(), pubs + i * 32, pubs + i * 32 + 32);
+    payload.insert(payload.end(), sigs + i * 64, sigs + i * 64 + 64);
+    put_u32(payload, msg_lens[i]);
+    payload.insert(payload.end(), mp, mp + msg_lens[i]);
+    mp += msg_lens[i];
+  }
+  std::vector<uint8_t> frame;
+  put_u32(frame, static_cast<uint32_t>(payload.size()));
+  frame.insert(frame.end(), payload.begin(), payload.end());
+  if (!send_all(c->fd, frame.data(), frame.size())) return -1;
+
+  uint8_t lenbuf[4];
+  if (!recv_all(c->fd, lenbuf, 4)) return -1;
+  uint32_t rlen = 0;
+  std::memcpy(&rlen, lenbuf, 4);
+  std::vector<uint8_t> resp(rlen);
+  if (!recv_all(c->fd, resp.data(), rlen)) return -1;
+  if (rlen < 13) return -1;
+  uint64_t got_id = 0;
+  std::memcpy(&got_id, resp.data(), 8);
+  if (got_id != req_id) return -1;  // single-flight per connection
+  const uint8_t batch_ok = resp[8];
+  uint32_t rn = 0;
+  std::memcpy(&rn, resp.data() + 9, 4);
+  if (rn != n || rlen != 13 + rn) return -1;
+  std::memcpy(out_ok, resp.data() + 13, n);
+  return batch_ok ? 1 : 0;
+}
+
+void dvc_close(void *h) {
+  if (h == nullptr) return;
+  Conn *c = static_cast<Conn *>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

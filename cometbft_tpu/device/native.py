@@ -1,0 +1,80 @@
+"""ctypes loader for the C client shim (devclient.cc) — used by tests
+to prove the C ABI end-to-end, and importable by any host that embeds
+CPython but marshals from native code. Build mirrors db/native."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "devclient.cc")
+_SO = os.path.join(_DIR, "devclient.so")
+
+_lib = None
+_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-o", _SO, _SRC],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO)
+        lib.dvc_connect.restype = ctypes.c_void_p
+        lib.dvc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dvc_verify.restype = ctypes.c_int
+        lib.dvc_verify.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8)]
+        lib.dvc_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+class NativeDeviceClient:
+    """DeviceClient over the C shim (one in-flight request at a time —
+    the shim is single-flight per connection by design)."""
+
+    def __init__(self, host: str, port: int):
+        self._lib = _load()
+        self._h = self._lib.dvc_connect(host.encode(), port)
+        if not self._h:
+            raise ConnectionError(f"dvc_connect {host}:{port} failed")
+        self._call_lock = threading.Lock()
+
+    def verify(self, pubs: List[bytes], msgs: List[bytes],
+               sigs: List[bytes]) -> Tuple[bool, List[bool]]:
+        n = len(pubs)
+        lens = (ctypes.c_uint32 * n)(*[len(m) for m in msgs])
+        out = (ctypes.c_uint8 * n)()
+        with self._call_lock:
+            rc = self._lib.dvc_verify(
+                self._h, n, b"".join(pubs), b"".join(sigs), lens,
+                b"".join(msgs), out)
+        if rc < 0:
+            raise ConnectionError("dvc_verify transport error")
+        return rc == 1, [bool(v) for v in out]
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dvc_close(self._h)
+            self._h = None

@@ -1,0 +1,99 @@
+"""Wire protocol for the verify device server (the host↔TPU boundary
+named by SURVEY §5.8 / §7 step 2: a persistent process owns the device;
+engines — including non-Python ones via the C shim — submit signature
+tiles over a local socket; reference analog: the cgo/gRPC bridge that
+would front curve25519-voi if it lived out-of-process).
+
+Framing: every message is u32le length || payload.
+
+Request payload:
+    req_id  u64le
+    n       u32le
+    n × record: pub(32) | sig(64) | msg_len u32le | msg bytes
+
+Response payload:
+    req_id   u64le
+    batch_ok u8       (1 iff every lane verified)
+    n        u32le
+    n × u8 per-lane validity
+
+The protocol is deliberately dumb-binary (no proto/JSON): a C caller
+can marshal it with memcpy, and the server's hot loop does one pass of
+struct unpacking per tile.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, Tuple
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            raise ConnectionError("peer closed")
+        buf += got
+    return buf
+
+
+def recv_frame(sock: socket.socket, max_len: int = 64 << 20) -> bytes:
+    (ln,) = struct.unpack("<I", recv_exact(sock, 4))
+    if ln > max_len:
+        raise ConnectionError(f"frame {ln} exceeds cap {max_len}")
+    return recv_exact(sock, ln)
+
+
+def encode_request(req_id: int, pubs: List[bytes], msgs: List[bytes],
+                   sigs: List[bytes]) -> bytes:
+    parts = [struct.pack("<QI", req_id, len(pubs))]
+    for p, m, s in zip(pubs, msgs, sigs):
+        if len(p) != 32 or len(s) != 64:
+            raise ValueError("pub must be 32 bytes, sig 64")
+        parts.append(p)
+        parts.append(s)
+        parts.append(struct.pack("<I", len(m)))
+        parts.append(m)
+    return b"".join(parts)
+
+
+def decode_request(payload: bytes
+                   ) -> Tuple[int, List[bytes], List[bytes], List[bytes]]:
+    try:
+        req_id, n = struct.unpack_from("<QI", payload, 0)
+    except struct.error as e:
+        raise ValueError(f"short request header: {e}") from e
+    off = 12
+    pubs, msgs, sigs = [], [], []
+    for _ in range(n):
+        pubs.append(payload[off:off + 32])
+        sigs.append(payload[off + 32:off + 96])
+        (mlen,) = struct.unpack_from("<I", payload, off + 96)
+        off += 100
+        msgs.append(payload[off:off + mlen])
+        off += mlen
+    if off != len(payload) or any(len(p) != 32 for p in pubs):
+        raise ValueError("malformed verify request")
+    return req_id, pubs, msgs, sigs
+
+
+def encode_response(req_id: int, batch_ok: bool, oks: List[bool]) -> bytes:
+    return (struct.pack("<QBI", req_id, 1 if batch_ok else 0, len(oks))
+            + bytes(1 if v else 0 for v in oks))
+
+
+def decode_response(payload: bytes) -> Tuple[int, bool, List[bool]]:
+    try:
+        req_id, batch_ok, n = struct.unpack_from("<QBI", payload, 0)
+    except struct.error as e:
+        raise ValueError(f"short response header: {e}") from e
+    body = payload[13:13 + n]
+    if len(body) != n:
+        raise ValueError("malformed verify response")
+    return req_id, bool(batch_ok), [b == 1 for b in body]

@@ -1,0 +1,214 @@
+"""Verify device server: the persistent process that OWNS the TPU and
+serves batched ed25519 verification to every other process on the host
+(SURVEY §7 step 2 "device server"; the reference's analog boundary is
+Go → cgo → curve25519-voi in-process — on TPU the device must be held
+by one process, so the boundary becomes a local socket).
+
+Design, TPU-first:
+- kernels compile ONCE per bucket size at startup (static shapes);
+- requests from all connections accumulate in a queue and are flushed
+  as one device tile (cross-request coalescing — the accumulate-and-
+  flush stance SURVEY §7 prescribes for every verify call site: many
+  small commits become one large lane-parallel batch);
+- per-lane verdicts are routed back per request, so one bad signature
+  in client A's commit never forces client B into a retry.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .protocol import (decode_request, encode_response, recv_frame,
+                       send_frame)
+
+
+@dataclass
+class _Job:
+    sock: socket.socket
+    lock: threading.Lock  # per-connection write lock
+    req_id: int
+    pubs: List[bytes]
+    msgs: List[bytes]
+    sigs: List[bytes]
+
+
+class DeviceServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 bucket: int = 1024, max_msg_len: int = 256,
+                 flush_us: int = 200):
+        self.bucket = bucket
+        self.max_msg_len = max_msg_len
+        self.flush_s = flush_us / 1e6
+        self._jobs: "queue.Queue[Optional[_Job]]" = queue.Queue()
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.addr = self._listener.getsockname()
+        self._stop = threading.Event()
+        self.stats = {"requests": 0, "signatures": 0, "flushes": 0}
+
+    # --- device side ----------------------------------------------------------
+
+    def _warm(self) -> None:
+        """Compile BOTH kernels for the configured bucket before
+        accepting traffic (first-compile latency must not land on a
+        live commit): the RLC fast path, and — by feeding one tampered
+        signature — the per-lane attribution fallback it degrades to."""
+        from ..libs.jax_cache import enable_compile_cache
+        enable_compile_cache()
+        from ..ops.ed25519 import verify_batch
+        seed = b"\x01" * 32
+        from ..crypto import ref_ed25519 as ref
+        pub = ref.pubkey_from_seed(seed)
+        sig = ref.sign(seed, b"warm")
+        bad = bytes([sig[0] ^ 1]) + sig[1:]
+        verify_batch([pub], [b"warm"], [sig], batch_size=self.bucket)
+        verify_batch([pub], [b"warm"], [bad], batch_size=self.bucket)
+
+    def _flush(self, jobs: List[_Job]) -> None:
+        from ..ops.ed25519 import verify_batch
+        pubs: List[bytes] = []
+        msgs: List[bytes] = []
+        sigs: List[bytes] = []
+        for j in jobs:
+            pubs.extend(j.pubs)
+            msgs.extend(j.msgs)
+            sigs.extend(j.sigs)
+        oks = verify_batch(pubs, msgs, sigs, batch_size=self.bucket)
+        self.stats["flushes"] += 1
+        self.stats["signatures"] += len(pubs)
+        off = 0
+        for j in jobs:
+            part = [bool(v) for v in oks[off:off + len(j.pubs)]]
+            off += len(j.pubs)
+            resp = encode_response(j.req_id, all(part), part)
+            try:
+                with j.lock:
+                    send_frame(j.sock, resp)
+            except OSError:
+                pass  # client gone; its lanes were still verified
+
+    def _device_routine(self) -> None:
+        """Single device writer: accumulate jobs, flush as one tile."""
+        while not self._stop.is_set():
+            try:
+                job = self._jobs.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if job is None:
+                return
+            batch = [job]
+            lanes = len(job.pubs)
+            # coalesce whatever arrives within the flush window, up to
+            # the bucket capacity
+            deadline = _now() + self.flush_s
+            while lanes < self.bucket:
+                try:
+                    nxt = self._jobs.get(timeout=max(
+                        0.0, deadline - _now()))
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._flush(batch)
+                    return
+                batch.append(nxt)
+                lanes += len(nxt.pubs)
+            self._flush(batch)
+
+    # --- socket side ----------------------------------------------------------
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        wlock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                payload = recv_frame(sock)
+                req_id, pubs, msgs, sigs = decode_request(payload)
+                self.stats["requests"] += 1
+                # oversized messages / batches can't ride the compiled
+                # bucket: answer UNPROCESSABLE (zero lanes for a
+                # nonzero request — distinct from per-lane failure, so
+                # clients fall back locally instead of treating valid
+                # signatures as forged)
+                if any(len(m) > self.max_msg_len for m in msgs) or \
+                        len(pubs) > self.bucket:
+                    with wlock:
+                        send_frame(sock, encode_response(
+                            req_id, False, []))
+                    continue
+                self._jobs.put(_Job(sock, wlock, req_id, pubs, msgs,
+                                    sigs))
+        except (ConnectionError, OSError, ValueError):
+            pass  # garbage or lost peer: drop the connection cleanly
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def start(self) -> None:
+        self._warm()
+        threading.Thread(target=self._device_routine,
+                         name="device-flush", daemon=True).start()
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    sock, _ = self._listener.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._serve_conn, args=(sock,),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, name="device-accept",
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._jobs.put(None)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def _now() -> float:
+    import time
+    return time.monotonic()
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="device-server")
+    ap.add_argument("--laddr", default="127.0.0.1:28657")
+    ap.add_argument("--bucket", type=int, default=1024)
+    ap.add_argument("--max-msg-len", type=int, default=256)
+    args = ap.parse_args(argv)
+    from ..libs.jax_cache import enable_compile_cache
+    enable_compile_cache()
+    host, _, port = args.laddr.rpartition(":")
+    srv = DeviceServer(host or "127.0.0.1", int(port),
+                       bucket=args.bucket,
+                       max_msg_len=args.max_msg_len)
+    srv.start()
+    import jax
+    print(f"device server on {srv.addr} device={jax.devices()[0]} "
+          f"bucket={srv.bucket}", flush=True)
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -17,6 +17,19 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     import jax
+    # the ambient TPU-tunnel setup pins jax_platforms programmatically
+    # (to "axon,cpu"), which BEATS the JAX_PLATFORMS env var — so a
+    # subprocess spawned with JAX_PLATFORMS=cpu (e2e nodes, the device
+    # server under test) would still try to grab the single-client
+    # tunnel first. Re-assert the env var's choice through jax.config,
+    # where it wins — but only over the ambient multi-platform default
+    # (has a comma / unset), never over an explicit single-platform
+    # choice already made in-process (tests' conftest pins "cpu" and
+    # may have initialized the backend; re-pointing it would hang).
+    plat = os.environ.get("JAX_PLATFORMS")
+    current = jax.config.jax_platforms
+    if plat and (not current or "," in current):
+        jax.config.update("jax_platforms", plat)
     jax.config.update(
         "jax_compilation_cache_dir",
         cache_dir or os.path.join(_REPO_ROOT, ".jax_cache"))

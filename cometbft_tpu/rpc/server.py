@@ -244,6 +244,143 @@ class Routes:
         return {"blocks": [self.block(h) for h in heights],
                 "total_count": len(heights)}
 
+    # --- consensus introspection (rpc/core/consensus.go) ----------------------
+
+    def consensus_state(self) -> dict:
+        """Compact round-state summary (reference /consensus_state)."""
+        cs = self.env.consensus
+        if cs is None:
+            raise RPCError(-32603, "no consensus engine")
+        rs = cs.rs
+        return {"round_state": {
+            "height": rs.height, "round": rs.round, "step": rs.step,
+            "proposal": rs.proposal is not None,
+            "proposal_block": rs.proposal_block is not None,
+            "locked_round": rs.locked_round,
+            "valid_round": rs.valid_round}}
+
+    def dump_consensus_state(self) -> dict:
+        """Verbose round state incl. vote bitmaps (reference
+        /dump_consensus_state)."""
+        cs = self.env.consensus
+        if cs is None:
+            raise RPCError(-32603, "no consensus engine")
+        rs = cs.rs
+        votes = []
+        if rs.votes is not None:
+            from ..types.vote import PREVOTE_TYPE, PRECOMMIT_TYPE
+            for r in range(rs.round + 1):
+                # read-only: create=False — lazily creating a VoteSet
+                # from the RPC thread would race the consensus writer's
+                # own lazy creation and could drop a just-added vote
+                pv = rs.votes._get(r, PREVOTE_TYPE, create=False)
+                pc = rs.votes._get(r, PRECOMMIT_TYPE, create=False)
+                votes.append({
+                    "round": r,
+                    "prevotes_bits": repr(pv.votes_bit_array)
+                    if pv else "",
+                    "precommits_bits": repr(pc.votes_bit_array)
+                    if pc else ""})
+        out = self.consensus_state()
+        out["round_state"]["height_vote_set"] = votes
+        peers = self.env.switch.peers() if self.env.switch else []
+        out["peers"] = [p.id for p in peers]
+        return out
+
+    def consensus_params(self, height=None) -> dict:
+        st = self.env.state_getter()
+        if st is None:
+            raise RPCError(-32603, "no state")
+        if height is not None and int(height) != st.last_block_height:
+            # params are not retained per height in this store; answer
+            # honestly rather than mislabeling current params
+            raise RPCError(
+                -32603, "historical consensus_params not retained; "
+                "omit height for the current params")
+        p = st.consensus_params
+        return {"block_height": st.last_block_height,
+                "consensus_params": {
+                    "block": {"max_bytes": p.max_block_bytes,
+                              "max_gas": p.max_gas},
+                    "evidence": {
+                        "max_age_num_blocks":
+                            p.evidence_max_age_num_blocks,
+                        "max_age_seconds": p.evidence_max_age_seconds,
+                        "max_bytes": p.evidence_max_bytes},
+                    "feature": {"vote_extensions_enable_height":
+                                p.vote_extensions_enable_height,
+                                "pbts_enable_height":
+                                p.pbts_enable_height}}}
+
+    # --- more block/tx conveniences (rpc/core/blocks.go) ----------------------
+
+    def block_by_hash(self, hash="") -> dict:
+        want = bytes.fromhex(hash)
+        store = self.env.block_store
+        h = store.height_by_hash(want)
+        if h is None:
+            # stores written before the BH: index: bounded recent scan
+            top = store.height()
+            for hh in range(top, max(store.base(), top - 1000) - 1, -1):
+                meta = store.load_block_meta(hh)
+                if meta is not None and meta[0].hash == want:
+                    h = hh
+                    break
+        if h is None or not (store.base() <= h <= store.height()):
+            raise RPCError(-32603, f"block {hash} not found")
+        return self.block(h)
+
+    def header_by_hash(self, hash="") -> dict:
+        return {"header": self.block_by_hash(hash)["block"]["header"]}
+
+    def num_unconfirmed_txs(self) -> dict:
+        return {"n_txs": self.env.mempool.size(),
+                "total": self.env.mempool.size(),
+                "total_bytes": self.env.mempool.size_bytes()}
+
+    def check_tx(self, tx="") -> dict:
+        """Run CheckTx without adding to the mempool (reference
+        /check_tx → app CheckTx on the query path)."""
+        r = self.env.app_query.check_tx(bytes.fromhex(tx))
+        return {"code": r.code, "log": r.log,
+                "gas_wanted": r.gas_wanted}
+
+    def genesis_chunked(self, chunk=None) -> dict:
+        import base64
+        import json as _json
+        g = self.genesis()
+        blob = _json.dumps(g, sort_keys=True).encode()
+        size = 16 * 1024
+        chunks = [blob[i:i + size] for i in range(0, len(blob), size)] \
+            or [b""]
+        i = int(chunk) if chunk is not None else 0
+        if not (0 <= i < len(chunks)):
+            raise RPCError(-32603, f"chunk {i} out of range")
+        return {"chunk": i, "total": len(chunks),
+                "data": base64.b64encode(chunks[i]).decode()}
+
+    def broadcast_tx_commit(self, tx="") -> dict:
+        """Submit and wait for the tx to be committed (reference
+        /broadcast_tx_commit — documented there as a dev tool, same
+        here; waits on the indexer rather than the event bus so it also
+        works when the node indexes in batch)."""
+        import time as _time
+        raw = bytes.fromhex(tx)
+        r = self.broadcast_tx_sync(tx)
+        if r["code"] != 0:
+            return {"check_tx": r, "hash": r["hash"]}
+        want = bytes.fromhex(r["hash"])
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            got = self.env.tx_indexer.get(want)
+            if got is not None:
+                height, _index, _raw, code = got
+                return {"check_tx": r, "hash": r["hash"],
+                        "height": height,
+                        "tx_result": {"code": code}}
+            _time.sleep(0.05)
+        raise RPCError(-32603, "timed out waiting for commit")
+
     # --- events (long-poll stand-in for the WS subscription) ------------------
 
     def wait_event(self, query="", timeout=None) -> dict:
@@ -273,11 +410,16 @@ class RPCServer:
             routes = Routes(env)
             methods = {
                 name: getattr(routes, name) for name in (
-                    "health", "status", "net_info", "genesis", "block",
-                    "blockchain", "commit", "header", "validators",
+                    "health", "status", "net_info", "genesis",
+                    "genesis_chunked", "block", "block_by_hash",
+                    "blockchain", "commit", "header", "header_by_hash",
+                    "validators", "consensus_state",
+                    "dump_consensus_state", "consensus_params",
                     "abci_info", "abci_query", "broadcast_tx_sync",
-                    "broadcast_tx_async", "unconfirmed_txs", "tx",
-                    "tx_search", "block_search", "wait_event")}
+                    "broadcast_tx_async", "broadcast_tx_commit",
+                    "check_tx", "unconfirmed_txs",
+                    "num_unconfirmed_txs", "tx", "tx_search",
+                    "block_search", "wait_event")}
 
         class Handler(BaseHTTPRequestHandler):
             # RFC 6455 requires the 101 on HTTP/1.1 (clients reject a

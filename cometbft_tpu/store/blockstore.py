@@ -64,6 +64,10 @@ class BlockStore:
                         block.hash(), parts.header).encode())
                     + proto.f_embed(2, block.header.encode()))
             sets.append((_h(b"H:", height), meta))
+            # hash -> height index (reference store.go keeps BH: keys)
+            # so /block_by_hash is one read, not a reverse scan
+            sets.append((b"BH:" + block.hash(),
+                         height.to_bytes(8, "big")))
             for part in parts.parts:
                 sets.append((_h(b"P:", height) + part.index.to_bytes(4, "big"),
                              part.bytes_))
@@ -100,6 +104,11 @@ class BlockStore:
         return (BlockID.decode(proto.field_one(f, 1, b"")),
                 Header.decode(proto.field_one(f, 2, b"")))
 
+    def height_by_hash(self, block_hash: bytes) -> Optional[int]:
+        """O(1) via the BH: index (reference store.go blockHashKey)."""
+        raw = self._db.get(b"BH:" + block_hash)
+        return int.from_bytes(raw, "big") if raw is not None else None
+
     def load_block_part(self, height: int, index: int) -> Optional[bytes]:
         return self._db.get(_h(b"P:", height) + index.to_bytes(4, "big"))
 
@@ -125,6 +134,7 @@ class BlockStore:
             deletes = [_h(b"H:", height), _h(b"C:", height),
                        _h(b"SC:", height)]
             if meta:
+                deletes.append(b"BH:" + meta[0].hash)
                 for i in range(meta[0].parts.total):
                     deletes.append(_h(b"P:", height)
                                    + i.to_bytes(4, "big"))
@@ -150,6 +160,7 @@ class BlockStore:
                 deletes.append(_h(b"C:", h))
                 deletes.append(_h(b"SC:", h))
                 if meta:
+                    deletes.append(b"BH:" + meta[0].hash)
                     for i in range(meta[0].parts.total):
                         deletes.append(_h(b"P:", h) + i.to_bytes(4, "big"))
                 pruned += 1

@@ -27,6 +27,15 @@ def test_statesync_sequence():
          "process_proposal", "finalize_block", "commit"],
         clean_start=True)
     assert ok, err
+    # an attempt that applied some chunks then aborted, before the
+    # attempt that succeeded (reference grammar's *state-sync-attempt)
+    ok, err = check_sequence(
+        ["init_chain",
+         "offer_snapshot", "apply_snapshot_chunk",      # aborted
+         "offer_snapshot", "apply_snapshot_chunk",      # succeeded
+         "finalize_block", "commit"],
+        clean_start=True)
+    assert ok, err
 
 
 def test_recovery_sequence():

@@ -132,6 +132,29 @@ def test_four_node_network_commits_and_serves_rpc(tmp_path):
                 break
             time.sleep(0.1)
         assert found["total_count"] >= 1
+
+        # breadth routes (reference rpc/core/routes.go surface)
+        cs = rpc1.call("consensus_state")
+        assert cs["round_state"]["height"] >= 2
+        dump = rpc1.call("dump_consensus_state")
+        assert "height_vote_set" in dump["round_state"]
+        cp = rpc1.call("consensus_params")
+        assert cp["consensus_params"]["block"]["max_bytes"] > 0
+        bh = blk["block_id"]["hash"]
+        byh = rpc1.call("block_by_hash", hash=bh)
+        assert byh["block"]["header"]["height"] == 1
+        assert rpc1.call("header_by_hash", hash=bh)[
+            "header"]["height"] == 1
+        assert rpc1.call("header", height=1)["header"]["height"] == 1
+        assert "n_txs" in rpc1.call("num_unconfirmed_txs")
+        assert rpc1.call("check_tx", tx=b"fmt".hex())["code"] != 0
+        g = rpc1.call("genesis_chunked")
+        assert g["total"] >= 1 and g["data"]
+        commit = rpc1.call("commit", height=1)
+        assert commit["signed_header"]["commit"]["signatures"]
+        done = rpc1.call("broadcast_tx_commit",
+                         tx=b"committed=yes".hex())
+        assert done["tx_result"]["code"] == 0 and done["height"] > 0
     finally:
         for nd in nodes:
             nd.stop()
