@@ -147,8 +147,12 @@ class Application(Protocol):
                    validators: List[ValidatorUpdate],
                    app_state_bytes: bytes) -> tuple[List[ValidatorUpdate],
                                                     bytes]: ...
-    def prepare_proposal(self, txs: List[bytes], max_tx_bytes: int
-                         ) -> List[bytes]: ...
+    def prepare_proposal(self, txs: List[bytes], max_tx_bytes: int,
+                         local_last_commit=None) -> List[bytes]:
+        """local_last_commit: [(validator_index, address, extension)]
+        from the previous height's extended commit when vote extensions
+        are enabled (reference abci RequestPrepareProposal
+        .local_last_commit.votes[].vote_extension), else None."""
     def process_proposal(self, txs: List[bytes], height: int) -> bool: ...
     def finalize_block(self, req: RequestFinalizeBlock
                        ) -> ResponseFinalizeBlock: ...
@@ -191,7 +195,8 @@ class BaseApplication:
                    app_state_bytes):
         return [], b""
 
-    def prepare_proposal(self, txs, max_tx_bytes):
+    def prepare_proposal(self, txs, max_tx_bytes,
+                         local_last_commit=None):
         out, total = [], 0
         for tx in txs:
             total += len(tx)

@@ -139,8 +139,13 @@ class ABCIServer:
             return {"code": r.code, "gas_wanted": r.gas_wanted,
                     "log": r.log}
         if method == _M_PREPARE:
+            llc = b.get("local_last_commit")
+            if llc is not None:
+                llc = [(e["index"], _unhx(e["address"]),
+                        _unhx(e["extension"])) for e in llc]
             txs = app.prepare_proposal([_unhx(t) for t in b["txs"]],
-                                       b["max_tx_bytes"])
+                                       b["max_tx_bytes"],
+                                       local_last_commit=llc)
             return {"txs": [_hx(t) for t in txs]}
         if method == _M_PROCESS:
             ok = app.process_proposal([_unhx(t) for t in b["txs"]],
@@ -246,9 +251,15 @@ class SocketClient:
                                    u["power"]) for u in r["updates"]]
         return updates, _unhx(r["app_hash"])
 
-    def prepare_proposal(self, txs, max_tx_bytes):
-        r = self._call(_M_PREPARE, {"txs": [_hx(t) for t in txs],
-                                    "max_tx_bytes": max_tx_bytes})
+    def prepare_proposal(self, txs, max_tx_bytes,
+                         local_last_commit=None):
+        llc = None
+        if local_last_commit is not None:
+            llc = [{"index": i, "address": _hx(a), "extension": _hx(e)}
+                   for i, a, e in local_last_commit]
+        r = self._call(_M_PREPARE, {
+            "txs": [_hx(t) for t in txs], "max_tx_bytes": max_tx_bytes,
+            "local_last_commit": llc})
         return [_unhx(t) for t in r["txs"]]
 
     def process_proposal(self, txs, height) -> bool:

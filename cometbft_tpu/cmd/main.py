@@ -262,6 +262,66 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_device_server(args) -> int:
+    from ..device.server import main as device_main
+    return device_main(["--laddr", args.laddr,
+                        "--bucket", str(args.bucket),
+                        "--max-msg-len", str(args.max_msg_len)])
+
+
+def cmd_reindex(args) -> int:
+    """Rebuild the tx/block indexes from stored blocks + saved ABCI
+    responses (reference commands/reindex_event.go)."""
+    from ..abci.application import ResponseFinalizeBlock
+    from ..db.kv import open_db
+    from ..indexer.kv import BlockIndexer, TxIndexer, reindex_block
+    from ..state.state import StateStore
+    from ..store.blockstore import BlockStore
+    cfg = _cfg(args.home)
+    be, ddir = cfg.base.db_backend, cfg.path(cfg.base.db_dir)
+    blocks = BlockStore(open_db(be, "blockstore", ddir))
+    states = StateStore(open_db(be, "state", ddir))
+    idx_db = open_db(be, "indexer", ddir)
+    txi, bli = TxIndexer(idx_db), BlockIndexer(idx_db)
+    lo = args.start_height or blocks.base()
+    hi = args.end_height or blocks.height()
+    n_blocks = n_txs = 0
+    for h in range(lo, hi + 1):
+        blk = blocks.load_block(h)
+        raw = states.load_finalize_block_response(h)
+        if blk is None or raw is None:
+            continue
+        n_txs += reindex_block(txi, bli, blk,
+                               ResponseFinalizeBlock.decode(raw))
+        n_blocks += 1
+    print(f"reindexed {n_blocks} blocks / {n_txs} txs "
+          f"(heights {lo}..{hi})")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Capture a running node's state into a debug directory
+    (reference commands/debug/: status, net_info, consensus dumps,
+    recent blockchain info over live RPC)."""
+    from ..rpc.client import RPCClient, RPCClientError
+    host, _, port = args.rpc.rpartition(":")
+    rpc = RPCClient(host or "127.0.0.1", int(port), timeout=10)
+    os.makedirs(args.o, exist_ok=True)
+    captured = []
+    for name in ("status", "net_info", "consensus_state",
+                 "dump_consensus_state", "consensus_params",
+                 "num_unconfirmed_txs", "blockchain"):
+        try:
+            out = rpc.call(name)
+        except (RPCClientError, OSError) as e:
+            out = {"error": str(e)}
+        with open(os.path.join(args.o, f"{name}.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        captured.append(name)
+    print(f"wrote {len(captured)} dumps to {args.o}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cometbft_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -309,10 +369,16 @@ def build_parser() -> argparse.ArgumentParser:
     dv.add_argument("--bucket", type=int, default=1024)
     dv.add_argument("--max-msg-len", dest="max_msg_len", type=int,
                     default=256)
-    dv.set_defaults(fn=lambda args: __import__(
-        "cometbft_tpu.device.server", fromlist=["main"]).main(
-        ["--laddr", args.laddr, "--bucket", str(args.bucket),
-         "--max-msg-len", str(args.max_msg_len)]))
+    dv.set_defaults(fn=cmd_device_server)
+    ri = add("reindex", cmd_reindex)
+    ri.add_argument("--start-height", dest="start_height", type=int,
+                    default=0)
+    ri.add_argument("--end-height", dest="end_height", type=int,
+                    default=0)
+    dbg = sub.add_parser("debug")
+    dbg.add_argument("--rpc", default="127.0.0.1:26657")
+    dbg.add_argument("--o", default="./debug-dump")
+    dbg.set_defaults(fn=cmd_debug)
     return p
 
 

@@ -657,13 +657,21 @@ class ConsensusState:
         block = rs.proposal_block
         parts = rs.proposal_block_parts
         bid = BlockID(block.hash(), parts.header)
-        seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+        precommits = rs.votes.precommits(rs.commit_round)
+        seen_commit = precommits.make_commit()
+        extended = None
+        if self.state.consensus_params.extensions_enabled(height):
+            # persist extensions beside the block: a restarted proposer
+            # must still feed them to PrepareProposal for height+1
+            # (reference SaveBlockWithExtendedCommit, state.go:1863)
+            extended = precommits.make_extended_commit()
 
         from ..libs.fail import fail_point
         fail_point("finalize:pre-save")              # state.go:1857
         if self.block_store is not None and \
                 self.block_store.height() < height:
-            self.block_store.save_block(block, parts, seen_commit)
+            self.block_store.save_block(block, parts, seen_commit,
+                                        extended_commit=extended)
         fail_point("finalize:post-save")             # state.go:1874
 
         # the WAL must know the height is decided before the app mutates

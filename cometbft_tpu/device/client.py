@@ -33,6 +33,11 @@ class DeviceClient:
     def __init__(self, host: str, port: int, timeout: float = 60.0):
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
+        # timeout above is for CONNECT only; the socket must then block
+        # indefinitely — the receive thread idles between batches and a
+        # lingering recv timeout would mark the link dead when merely
+        # quiet (per-request deadlines live in verify())
+        self._sock.settimeout(None)
         self._wlock = threading.Lock()
         self._pending: Dict[int, threading.Event] = {}
         self._results: Dict[int, Tuple[bool, List[bool]]] = {}
@@ -59,8 +64,13 @@ class DeviceClient:
                 self._pending.clear()
 
     def verify(self, pubs: List[bytes], msgs: List[bytes],
-               sigs: List[bytes], timeout: float = 120.0
+               sigs: List[bytes], timeout: float = 60.0
                ) -> Tuple[bool, List[bool]]:
+        """timeout bounds a WEDGED server (kernels are pre-warmed at
+        server start, so a healthy device flush is milliseconds; the
+        margin accommodates CPU-backed test servers) — callers like
+        RemoteBatchVerifier then degrade to local verification rather
+        than stalling the consensus verify path forever."""
         if not pubs:
             return False, []
         req_id = next(self._ids)

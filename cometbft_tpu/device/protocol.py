@@ -67,17 +67,17 @@ def decode_request(payload: bytes
                    ) -> Tuple[int, List[bytes], List[bytes], List[bytes]]:
     try:
         req_id, n = struct.unpack_from("<QI", payload, 0)
-    except struct.error as e:
-        raise ValueError(f"short request header: {e}") from e
-    off = 12
-    pubs, msgs, sigs = [], [], []
-    for _ in range(n):
-        pubs.append(payload[off:off + 32])
-        sigs.append(payload[off + 32:off + 96])
-        (mlen,) = struct.unpack_from("<I", payload, off + 96)
-        off += 100
-        msgs.append(payload[off:off + mlen])
-        off += mlen
+        off = 12
+        pubs, msgs, sigs = [], [], []
+        for _ in range(n):
+            pubs.append(payload[off:off + 32])
+            sigs.append(payload[off + 32:off + 96])
+            (mlen,) = struct.unpack_from("<I", payload, off + 96)
+            off += 100
+            msgs.append(payload[off:off + mlen])
+            off += mlen
+    except struct.error as e:  # truncated header OR truncated record
+        raise ValueError(f"malformed verify request: {e}") from e
     if off != len(payload) or any(len(p) != 32 for p in pubs):
         raise ValueError("malformed verify request")
     return req_id, pubs, msgs, sigs

@@ -1,0 +1,25 @@
+"""Randomized e2e manifest generator (reference test/e2e/generator/:
+deterministic seed → a spread of testnet configurations, so CI explores
+config space instead of one blessed topology)."""
+
+from __future__ import annotations
+
+import random
+from typing import List
+
+from .runner import Manifest
+
+VALIDATOR_CHOICES = [2, 3, 4, 5]
+TIMEOUT_COMMIT_CHOICES = [20, 50, 100, 250]
+
+
+def generate_manifests(seed: int = 1, n: int = 4) -> List[Manifest]:
+    """n deterministic pseudo-random manifests for the given seed."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        out.append(Manifest(
+            chain_id=f"gen-{seed}-{i}",
+            validators=rng.choice(VALIDATOR_CHOICES),
+            timeout_commit_ms=rng.choice(TIMEOUT_COMMIT_CHOICES)))
+    return out

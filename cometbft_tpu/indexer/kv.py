@@ -125,6 +125,27 @@ class BlockIndexer:
         return sorted(result)[:limit] if result else []
 
 
+def reindex_block(tx_indexer: "TxIndexer",
+                  block_indexer: "BlockIndexer", block, resp) -> int:
+    """Re-derive index postings for one stored block from its saved
+    FinalizeBlockResponse (reference cmd reindex_event.go) — the same
+    composite-key attrs the live bus path produces
+    (pubsub/events.py publish_tx / publish_new_block). Returns the
+    number of txs indexed."""
+    from ..types.block import tx_hash
+    height = block.header.height
+    block_indexer.index(height, {"block.height": [str(height)]})
+    for i, tx in enumerate(block.data.txs):
+        result = resp.tx_results[i]
+        attrs = {"tx.hash": [tx_hash(tx).hex().upper()],
+                 "tx.height": [str(height)]}
+        for ev_type, kvs in getattr(result, "events", []) or []:
+            for k, v in kvs:
+                attrs.setdefault(f"{ev_type}.{k}", []).append(str(v))
+        tx_indexer.index(height, i, tx, result, attrs)
+    return len(block.data.txs)
+
+
 class IndexerService:
     """reference state/txindex/indexer_service.go: subscribes to the
     event bus and indexes everything as it commits."""

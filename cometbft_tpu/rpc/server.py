@@ -348,11 +348,13 @@ class Routes:
     def genesis_chunked(self, chunk=None) -> dict:
         import base64
         import json as _json
-        g = self.genesis()
-        blob = _json.dumps(g, sort_keys=True).encode()
-        size = 16 * 1024
-        chunks = [blob[i:i + size] for i in range(0, len(blob), size)] \
-            or [b""]
+        chunks = getattr(self, "_genesis_chunks", None)
+        if chunks is None:  # serialize once; genesis never changes
+            blob = _json.dumps(self.genesis(), sort_keys=True).encode()
+            size = 16 * 1024
+            chunks = [blob[i:i + size]
+                      for i in range(0, len(blob), size)] or [b""]
+            self._genesis_chunks = chunks
         i = int(chunk) if chunk is not None else 0
         if not (0 <= i < len(chunks)):
             raise RPCError(-32603, f"chunk {i} out of range")

@@ -107,7 +107,10 @@ class BlockExecutor:
     def create_proposal_block(self, height: int, state: State,
                               last_commit: Commit,
                               proposer_address: bytes) -> Block:
-        """reference state/execution.go:109-166."""
+        """reference state/execution.go:109-166. When vote extensions
+        were enabled for the previous height, the persisted extended
+        commit's extensions ride to the app with PrepareProposal
+        (reference buildExtendedCommitInfo, execution.go:136)."""
         max_bytes = state.consensus_params.max_block_bytes
         evidence = []
         if self.evidence_pool is not None:
@@ -121,7 +124,15 @@ class BlockExecutor:
         if self.mempool is not None:
             txs = self.mempool.reap_max_bytes_max_gas(
                 data_budget, state.consensus_params.max_gas)
-        txs = self.app.prepare_proposal(txs, data_budget)
+        local_last_commit = None
+        if height > state.initial_height and \
+                state.consensus_params.extensions_enabled(height - 1) \
+                and self.block_store is not None:
+            ec = self.block_store.load_extended_commit(height - 1)
+            if ec is not None:
+                local_last_commit = ec.extensions()
+        txs = self.app.prepare_proposal(
+            txs, data_budget, local_last_commit=local_last_commit)
         return state.make_block(height, txs, last_commit, proposer_address,
                                 evidence=evidence)
 

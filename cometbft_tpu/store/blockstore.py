@@ -48,8 +48,10 @@ class BlockStore:
             return self._height - self._base + 1 if self._height else 0
 
     def save_block(self, block: Block, parts: PartSet,
-                   seen_commit: Commit) -> None:
-        """reference store/store.go:527 SaveBlock."""
+                   seen_commit: Commit, extended_commit=None) -> None:
+        """reference store/store.go:527 SaveBlock /
+        SaveBlockWithExtendedCommit (extensions must survive a restart
+        so the next proposer can feed them to PrepareProposal)."""
         height = block.header.height
         with self._lock:
             # idempotent for the current tip: a crash between save and
@@ -76,6 +78,9 @@ class BlockStore:
                 sets.append((_h(b"C:", height - 1),
                              block.last_commit.encode()))
             sets.append((_h(b"SC:", height), seen_commit.encode()))
+            if extended_commit is not None:
+                sets.append((_h(b"EC:", height),
+                             extended_commit.encode()))
             new_base = self._base or height
             sets.append((_KEY_BASE, new_base.to_bytes(8, "big")))
             sets.append((_KEY_HEIGHT, height.to_bytes(8, "big")))
@@ -118,6 +123,12 @@ class BlockStore:
         raw = self._db.get(_h(b"C:", height))
         return Commit.decode(raw) if raw is not None else None
 
+    def load_extended_commit(self, height: int):
+        """reference store.go LoadBlockExtendedCommit."""
+        from ..types.extended_commit import ExtendedCommit
+        raw = self._db.get(_h(b"EC:", height))
+        return ExtendedCommit.decode(raw) if raw is not None else None
+
     def load_seen_commit(self, height: int) -> Optional[Commit]:
         raw = self._db.get(_h(b"SC:", height))
         return Commit.decode(raw) if raw is not None else None
@@ -132,7 +143,7 @@ class BlockStore:
                     f"got {height}")
             meta = self.load_block_meta(height)
             deletes = [_h(b"H:", height), _h(b"C:", height),
-                       _h(b"SC:", height)]
+                       _h(b"SC:", height), _h(b"EC:", height)]
             if meta:
                 deletes.append(b"BH:" + meta[0].hash)
                 for i in range(meta[0].parts.total):
@@ -159,6 +170,7 @@ class BlockStore:
                 deletes.append(_h(b"H:", h))
                 deletes.append(_h(b"C:", h))
                 deletes.append(_h(b"SC:", h))
+                deletes.append(_h(b"EC:", h))
                 if meta:
                     deletes.append(b"BH:" + meta[0].hash)
                     for i in range(meta[0].parts.total):

@@ -399,6 +399,22 @@ class VoteSet:
         return Commit(height=self.height, round=self.round,
                       block_id=self.maj23, signatures=sigs)
 
+    def make_extended_commit(self) -> "ExtendedCommit":
+        """Commit + the vote extensions that rode each precommit
+        (reference vote_set.go:635 MakeExtendedCommit)."""
+        from .extended_commit import ExtendedCommit, ExtendedCommitSig
+        commit = self.make_commit()
+        ext_sigs = []
+        for cs, v in zip(commit.signatures, self.votes):
+            if cs.for_block() and v is not None:
+                ext_sigs.append(ExtendedCommitSig(
+                    cs, v.extension, v.extension_signature))
+            else:
+                ext_sigs.append(ExtendedCommitSig(cs))
+        return ExtendedCommit(height=commit.height, round=commit.round,
+                              block_id=commit.block_id,
+                              signatures=ext_sigs)
+
     def __repr__(self) -> str:
         voted = self.votes_bit_array.num_true_bits()
         return (f"VoteSet{{H:{self.height} R:{self.round} "

@@ -126,3 +126,65 @@ def test_cli_init_testnet_show(tmp_path):
         assert main(["show-validator", "--home", home]) == 0
     v = json.loads(buf.getvalue())
     assert v["type"] == "ed25519" and len(bytes.fromhex(v["value"])) == 32
+
+
+def test_cli_reindex_and_debug(tmp_path):
+    """Rebuild indexes offline (reference reindex_event.go) and capture
+    a live node's debug dumps (reference commands/debug/)."""
+    import json
+    import time
+
+    from cometbft_tpu.cmd.main import main as cli
+    from cometbft_tpu.e2e.runner import Manifest, Testnet
+
+    net = Testnet(Manifest(chain_id="aux-net", validators=2,
+                           timeout_commit_ms=50), str(tmp_path / "net"))
+    net.setup()
+    net.start()
+    try:
+        net.wait_for_height(2, timeout=240)
+        r = net.nodes[0].rpc().broadcast_tx_sync(b"idx=me")
+        assert r["code"] == 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            found = net.nodes[0].rpc().call(
+                "tx_search", query="tx.height > 0")
+            if found["total_count"] >= 1:
+                break
+            time.sleep(0.2)
+        assert found["total_count"] >= 1
+
+        # live debug capture over RPC
+        out = tmp_path / "dump"
+        rc = cli(["debug", "--rpc",
+                  f"127.0.0.1:{net.nodes[0].rpc_port}",
+                  "--o", str(out)])
+        assert rc == 0
+        st = json.loads((out / "status.json").read_text())
+        assert st["sync_info"]["latest_block_height"] >= 2
+        cs = json.loads((out / "consensus_state.json").read_text())
+        assert cs["round_state"]["height"] >= 2
+    finally:
+        net.stop()
+
+    # offline reindex over the stopped node's data dir: wipes nothing,
+    # must restore search results into a FRESH indexer db
+    home = net.nodes[0].home
+    import shutil
+    ddir_indexer = None
+    from cometbft_tpu.config import Config
+    cfg = Config.load(home)
+    ddir = cfg.path(cfg.base.db_dir)
+    for name in list(__import__("os").listdir(ddir)):
+        if "indexer" in name:
+            p = __import__("os").path.join(ddir, name)
+            (shutil.rmtree if __import__("os").path.isdir(p)
+             else __import__("os").remove)(p)
+    rc = cli(["reindex", "--home", home])
+    assert rc == 0
+
+    from cometbft_tpu.db.kv import open_db
+    from cometbft_tpu.indexer.kv import TxIndexer
+    from cometbft_tpu.pubsub.query import Query
+    txi = TxIndexer(open_db(cfg.base.db_backend, "indexer", ddir))
+    assert txi.search(Query("tx.height > 0"), 10)

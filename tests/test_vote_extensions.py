@@ -83,3 +83,43 @@ def test_extensionless_precommit_rejected_when_enabled():
     # properly signed -> accepted
     v.extension_signature = key.sign(v.extension_sign_bytes("ext-chain"))
     assert vs.add_vote(v)
+
+
+class RecordingExtApp(ExtApp):
+    """ExtApp that records what PrepareProposal received."""
+
+    def prepare_proposal(self, txs, max_tx_bytes,
+                         local_last_commit=None):
+        if not hasattr(self, "seen_llc"):
+            self.seen_llc = []
+        self.seen_llc.append(local_last_commit)
+        return super().prepare_proposal(txs, max_tx_bytes)
+
+
+def test_extended_commit_persisted_and_fed_to_prepare_proposal():
+    """Extensions survive in the block store's extended commit and ride
+    to PrepareProposal (reference SaveBlockWithExtendedCommit +
+    buildExtendedCommitInfo, state/execution.go:136)."""
+    c = _ext_cluster()
+    for node in c.nodes:
+        node.app.__class__ = RecordingExtApp
+    try:
+        c.start()
+        c.wait_for_height(3, timeout=90)
+    finally:
+        c.stop()
+    node = c.nodes[0]
+    # persisted: EC entry decodes, strips to the seen commit, and
+    # carries each signer's extension
+    ec = node.block_store.load_extended_commit(2)
+    assert ec is not None
+    assert ec.to_commit().block_id == \
+        node.block_store.load_seen_commit(2).block_id
+    exts = ec.extensions()
+    assert exts and all(ext == b"ext-2" for _i, _a, ext in exts)
+    # fed to the app: some proposer beyond height 1 saw extensions
+    fed = [llc for n in c.nodes
+           for llc in getattr(n.app, "seen_llc", []) if llc]
+    assert fed, "no proposer received local_last_commit extensions"
+    assert all(ext.startswith(b"ext-") for llc in fed
+               for _i, _a, ext in llc)

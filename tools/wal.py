@@ -1,0 +1,126 @@
+"""WAL debug tools: dump a consensus WAL to JSON lines and rebuild a
+WAL from them (reference scripts/wal2json, scripts/json2wal — the
+operator tooling for inspecting and hand-repairing a node's
+write-ahead log).
+
+Usage:
+    python tools/wal.py wal2json <wal-file> [> out.jsonl]
+    python tools/wal.py json2wal <out.jsonl> <new-wal-file>
+
+Round-trip is byte-exact at the message level: json2wal(wal2json(w))
+replays identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.consensus.wal import (  # noqa: E402
+    EndHeightMessage, WAL, WALBlockPart, WALProposal, WALTimeout,
+    WALVote, _decode_proposal, _encode_proposal)
+from cometbft_tpu.types.vote import Vote  # noqa: E402
+
+
+def msg_to_json(m) -> dict:
+    if isinstance(m, EndHeightMessage):
+        return {"type": "end_height", "height": m.height}
+    if isinstance(m, WALVote):
+        return {"type": "vote", "vote": m.vote.encode().hex(),
+                "peer_id": m.peer_id,
+                "summary": {"h": m.vote.height, "r": m.vote.round,
+                            "t": m.vote.type_,
+                            "val": m.vote.validator_index,
+                            "nil": m.vote.is_nil()}}
+    if isinstance(m, WALProposal):
+        return {"type": "proposal",
+                "proposal": _encode_proposal(m.proposal).hex(),
+                "peer_id": m.peer_id,
+                "summary": {"h": m.proposal.height,
+                            "r": m.proposal.round}}
+    if isinstance(m, WALBlockPart):
+        return {"type": "block_part", "height": m.height,
+                "round": m.round, "index": m.index,
+                "part": m.part.hex(), "peer_id": m.peer_id}
+    if isinstance(m, WALTimeout):
+        return {"type": "timeout", "height": m.height, "round": m.round,
+                "step": m.step, "duration_ms": m.duration_ms}
+    raise TypeError(f"unknown WAL message {type(m)}")
+
+
+def msg_from_json(d: dict):
+    t = d["type"]
+    if t == "end_height":
+        return EndHeightMessage(d["height"])
+    if t == "vote":
+        return WALVote(Vote.decode(bytes.fromhex(d["vote"])),
+                       d.get("peer_id", ""))
+    if t == "proposal":
+        return WALProposal(
+            _decode_proposal(bytes.fromhex(d["proposal"])),
+            d.get("peer_id", ""))
+    if t == "block_part":
+        return WALBlockPart(d["height"], d["round"], d["index"],
+                            bytes.fromhex(d["part"]),
+                            d.get("peer_id", ""))
+    if t == "timeout":
+        return WALTimeout(d["height"], d["round"], d["step"],
+                          d["duration_ms"])
+    raise ValueError(f"unknown WAL json type {t!r}")
+
+
+def wal2json(path: str, out=sys.stdout) -> int:
+    wal = WAL(path)
+    n = 0
+    try:
+        for m in wal.iter_messages():
+            out.write(json.dumps(msg_to_json(m)) + "\n")
+            n += 1
+    finally:
+        wal.close()
+    return n
+
+
+def json2wal(json_path: str, wal_path: str) -> int:
+    wal = WAL(wal_path)
+    n = 0
+    try:
+        with open(json_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                m = msg_from_json(json.loads(line))
+                if isinstance(m, EndHeightMessage):
+                    wal.write_sync(m)
+                else:
+                    wal.write(m)
+                n += 1
+    finally:
+        wal.close()
+    return n
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    w2j = sub.add_parser("wal2json")
+    w2j.add_argument("wal")
+    j2w = sub.add_parser("json2wal")
+    j2w.add_argument("json")
+    j2w.add_argument("wal")
+    args = ap.parse_args()
+    if args.cmd == "wal2json":
+        wal2json(args.wal)
+    else:
+        n = json2wal(args.json, args.wal)
+        print(f"wrote {n} messages to {args.wal}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
