@@ -32,6 +32,8 @@ class P2PConfig:
     persistent_peers: str = ""          # comma-separated host:port
     max_num_inbound_peers: int = 40
     max_num_outbound_peers: int = 10
+    send_rate: int = 5_120_000          # bytes/s (config.go SendRate)
+    recv_rate: int = 5_120_000          # bytes/s (config.go RecvRate)
 
 
 @dataclass

@@ -34,10 +34,18 @@ class DeviceClient:
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
         # timeout above is for CONNECT only; the socket must then block
-        # indefinitely — the receive thread idles between batches and a
-        # lingering recv timeout would mark the link dead when merely
-        # quiet (per-request deadlines live in verify())
+        # indefinitely on RECV — the receive thread idles between
+        # batches and a lingering recv timeout would mark the link dead
+        # when merely quiet (per-request deadlines live in verify()).
+        # SENDS stay bounded via SO_SNDTIMEO: a wedged server that
+        # stops reading must not park sendall under _wlock forever
+        # (that would block every verify() caller and defeat the local
+        # fallback).
         self._sock.settimeout(None)
+        import struct as _struct
+        self._sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+            _struct.pack("ll", 20, 0))
         self._wlock = threading.Lock()
         self._pending: Dict[int, threading.Event] = {}
         self._results: Dict[int, Tuple[bool, List[bool]]] = {}

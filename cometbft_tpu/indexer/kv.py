@@ -132,17 +132,13 @@ def reindex_block(tx_indexer: "TxIndexer",
     composite-key attrs the live bus path produces
     (pubsub/events.py publish_tx / publish_new_block). Returns the
     number of txs indexed."""
-    from ..types.block import tx_hash
+    from ..pubsub.events import tx_event_attrs
     height = block.header.height
     block_indexer.index(height, {"block.height": [str(height)]})
     for i, tx in enumerate(block.data.txs):
         result = resp.tx_results[i]
-        attrs = {"tx.hash": [tx_hash(tx).hex().upper()],
-                 "tx.height": [str(height)]}
-        for ev_type, kvs in getattr(result, "events", []) or []:
-            for k, v in kvs:
-                attrs.setdefault(f"{ev_type}.{k}", []).append(str(v))
-        tx_indexer.index(height, i, tx, result, attrs)
+        tx_indexer.index(height, i, tx, result,
+                         tx_event_attrs(height, tx, result))
     return len(block.data.txs)
 
 

@@ -159,7 +159,9 @@ class Node:
         self.node_key = node_key or load_or_generate_node_key(
             config.path(config.base.node_key_file))
         self.switch = Switch(self.node_key, self.genesis.chain_id,
-                             config.base.moniker)
+                             config.base.moniker,
+                             send_rate=config.p2p.send_rate,
+                             recv_rate=config.p2p.recv_rate)
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.consensus_reactor.attach(self.switch)
         self.blocksync_reactor = BlocksyncNetReactor(self.block_store)
@@ -226,10 +228,11 @@ class Node:
         self.p2p_addr = self.switch.listen(host, port)
         for peer in filter(None, self.config.p2p.persistent_peers.split(",")):
             ph, _, pp = peer.strip().rpartition(":")
-            try:
-                self.switch.dial(ph, int(pp))
-            except OSError:
-                pass  # reference retries via ensurePeers; peers also dial us
+            # registered (not one-shot dialed): the switch's
+            # ensure-peers routine dials now and re-dials on any drop —
+            # a node that loses all links otherwise stays isolated
+            # forever and stalls consensus
+            self.switch.add_persistent_peer(ph, int(pp))
         if self.config.base.block_sync:
             # blocksync to the peer tip BEFORE consensus (the reference's
             # blocksync mode → switchToConsensus,

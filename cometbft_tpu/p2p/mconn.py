@@ -28,9 +28,33 @@ from ..types import proto
 
 MAX_PACKET_PAYLOAD = 1400          # connection.go defaultMaxPacketMsgPayloadSize
 PING_INTERVAL = 10.0
+DEFAULT_SEND_RATE = 5_120_000      # bytes/s, connection.go:725 SendRate
+DEFAULT_RECV_RATE = 5_120_000      # connection.go:726 RecvRate
 _PKT_PING = 1
 _PKT_PONG = 2
 _PKT_MSG = 3
+
+
+class _RateMonitor:
+    """Token-bucket throttle (the role internal/flowrate plays for
+    MConnection's sendMonitor/recvMonitor, connection.go:429,567):
+    `limit(n)` sleeps just enough to keep the moving average at the
+    configured bytes/s."""
+
+    def __init__(self, rate: int, burst_s: float = 0.1):
+        self.rate = max(int(rate), 1)
+        self._allow = self.rate * burst_s  # start with one burst budget
+        self._burst = self.rate * burst_s
+        self._last = time.monotonic()
+
+    def limit(self, n: int) -> None:
+        now = time.monotonic()
+        self._allow = min(self._allow + (now - self._last) * self.rate,
+                          self._burst)
+        self._last = now
+        self._allow -= n
+        if self._allow < 0:
+            time.sleep(-self._allow / self.rate)
 
 
 @dataclass
@@ -82,8 +106,12 @@ class MConnection:
 
     def __init__(self, conn, descs: List[ChannelDescriptor],
                  on_receive: Callable[[int, bytes], None],
-                 on_error: Optional[Callable[[Exception], None]] = None):
+                 on_error: Optional[Callable[[Exception], None]] = None,
+                 send_rate: int = DEFAULT_SEND_RATE,
+                 recv_rate: int = DEFAULT_RECV_RATE):
         self._conn = conn
+        self._send_monitor = _RateMonitor(send_rate)
+        self._recv_monitor = _RateMonitor(recv_rate)
         self._channels: Dict[int, _Channel] = {
             d.id: _Channel(d) for d in descs}
         self._on_receive = on_receive
@@ -145,6 +173,7 @@ class MConnection:
                     continue
                 pkt = ch.next_packet()
                 if pkt is not None:
+                    self._send_monitor.limit(len(pkt))
                     self._conn.send_message(pkt)
                 # decay so bursts don't permanently deprioritize
                 for c in self._channels.values():
@@ -159,6 +188,10 @@ class MConnection:
                 raw = self._conn.recv_message()
                 if not raw:
                     continue
+                # backpressure a flooding peer (recvMonitor,
+                # connection.go:567): stop draining faster than the
+                # configured rate so TCP pushes back upstream
+                self._recv_monitor.limit(len(raw))
                 kind = raw[0]
                 if kind == _PKT_PING:
                     self._conn.send_message(bytes([_PKT_PONG]))

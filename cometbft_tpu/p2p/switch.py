@@ -9,8 +9,10 @@ connected peer's channel queue.
 
 from __future__ import annotations
 
+import random
 import threading
-from typing import Callable, Dict, List, Optional, Protocol
+import time
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from ..crypto.keys import Ed25519PrivKey
 from .conn import SecretConnection
@@ -39,7 +41,8 @@ class Peer:
         self._mconn = MConnection(
             sc, switch.channel_descriptors(),
             on_receive=lambda cid, msg: switch._dispatch(self, cid, msg),
-            on_error=lambda e: switch.stop_peer(self, f"conn error: {e}"))
+            on_error=lambda e: switch.stop_peer(self, f"conn error: {e}"),
+            send_rate=switch.send_rate, recv_rate=switch.recv_rate)
 
     def start(self) -> None:
         self._mconn.start()
@@ -61,9 +64,13 @@ class Switch:
     """reference p2p/switch.go Switch."""
 
     def __init__(self, priv_key: Ed25519PrivKey, network: str,
-                 moniker: str = "node"):
+                 moniker: str = "node",
+                 send_rate: int = 5_120_000,
+                 recv_rate: int = 5_120_000):
         self.priv_key = priv_key
         self.network = network
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
         self._reactors: List[Reactor] = []
         self._chan_to_reactor: Dict[int, Reactor] = {}
         self._peers: Dict[str, Peer] = {}
@@ -71,6 +78,17 @@ class Switch:
         self._moniker = moniker
         self.transport: Optional[Transport] = None
         self.banned: set = set()
+        # persistent peers: (host, port) -> last-known peer id ("" until
+        # a dial succeeds). The ensure-peers routine re-dials any entry
+        # whose peer is not currently connected — liveness depends on
+        # this: a simultaneous-dial race can close BOTH duplicate
+        # connections (each side keeps a different one), and without
+        # re-dialing the isolated node never hears another vote and
+        # stops scheduling timeouts after its own prevote (reference
+        # p2p/pex ensurePeers + switch reconnectToPeer).
+        self._persistent: Dict[Tuple[str, int], str] = {}
+        self._ensure_stop = threading.Event()
+        self._ensure_thread: Optional[threading.Thread] = None
 
     # --- setup ----------------------------------------------------------------
 
@@ -98,7 +116,48 @@ class Switch:
         """reference switch.go DialPeerWithAddress."""
         if self.transport is None:
             self.listen()
-        self.transport.dial(host, port, self._on_connection)
+
+        def on_conn(sc: SecretConnection, info: NodeInfo,
+                    outbound: bool) -> None:
+            addr = (host, port)
+            if addr in self._persistent:
+                self._persistent[addr] = info.node_id
+            self._on_connection(sc, info, outbound)
+
+        self.transport.dial(host, port, on_conn)
+
+    def add_persistent_peer(self, host: str, port: int) -> None:
+        """Register for dial-now + re-dial-forever (reference
+        config persistent_peers semantics)."""
+        self._persistent[(host, port)] = ""
+        if self._ensure_thread is None:
+            self._ensure_thread = threading.Thread(
+                target=self._ensure_peers_routine, name="ensure-peers",
+                daemon=True)
+            self._ensure_thread.start()
+
+    def _persistent_connected(self, addr: Tuple[str, int]) -> bool:
+        pid = self._persistent.get(addr, "")
+        with self._lock:
+            return bool(pid) and pid in self._peers
+
+    def _ensure_peers_routine(self) -> None:
+        while not self._ensure_stop.is_set():
+            for addr in list(self._persistent):
+                if self._persistent_connected(addr):
+                    continue
+                pid = self._persistent.get(addr, "")
+                if pid and pid in self.banned:
+                    # a banned peer would complete the whole handshake
+                    # just to be closed — don't churn crypto forever
+                    continue
+                try:
+                    self.dial(*addr)
+                except OSError:
+                    pass  # peer down; retried next round
+            # jitter desynchronizes simultaneous re-dials between two
+            # nodes that each just closed the other's duplicate
+            self._ensure_stop.wait(1.0 + random.random())
 
     # --- peer lifecycle -------------------------------------------------------
 
@@ -156,6 +215,7 @@ class Switch:
             self.stop_peer(peer, f"reactor error: {e}", ban=True)
 
     def stop(self) -> None:
+        self._ensure_stop.set()
         if self.transport is not None:
             self.transport.close()
         for peer in self.peers():

@@ -27,6 +27,22 @@ QUERY_NEW_BLOCK = Query("tm.event = 'NewBlock'")
 QUERY_TX = Query("tm.event = 'Tx'")
 
 
+def tx_event_attrs(height: int, tx: bytes, result) -> Dict[str, List[str]]:
+    """The tx event's composite-key attributes (events.go:180): the ONE
+    definition shared by the live bus path (publish_tx) and offline
+    reindexing (indexer.kv.reindex_block) — the two must never diverge
+    or a reindex breaks tx_search parity."""
+    from ..types.block import tx_hash
+    attrs: Dict[str, List[str]] = {
+        "tx.hash": [tx_hash(tx).hex().upper()],
+        "tx.height": [str(height)],
+    }
+    for ev_type, kvs in getattr(result, "events", []) or []:
+        for k, v in kvs:
+            attrs.setdefault(f"{ev_type}.{k}", []).append(str(v))
+    return attrs
+
+
 @dataclass
 class Event:
     kind: str
@@ -67,15 +83,8 @@ class EventBus:
                    result) -> None:
         """Tx event with app-emitted attributes flattened to composite
         keys (events.go:180 composite key rule)."""
-        from ..types.block import tx_hash
-        attrs: Dict[str, List[str]] = {
-            "tx.hash": [tx_hash(tx).hex().upper()],
-            "tx.height": [str(height)],
-        }
-        for ev_type, kvs in getattr(result, "events", []) or []:
-            for k, v in kvs:
-                attrs.setdefault(f"{ev_type}.{k}", []).append(str(v))
-        self._publish(EVENT_TX, (height, index, tx, result), attrs)
+        self._publish(EVENT_TX, (height, index, tx, result),
+                      tx_event_attrs(height, tx, result))
 
     def publish_vote(self, vote) -> None:
         self._publish(EVENT_VOTE, vote, {})

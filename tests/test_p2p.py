@@ -184,3 +184,31 @@ def test_switch_rejects_wrong_network():
     time.sleep(0.5)
     assert not sa.peers() and not sb.peers()
     sa.stop(); sb.stop()
+
+
+def test_mconnection_send_rate_limited():
+    """The token-bucket send monitor (the internal/flowrate analog,
+    connection.go:429 sendMonitor) paces bulk transfer to the
+    configured rate."""
+    ca, cb, *_ = _secret_pair()
+    done = threading.Event()
+    got = []
+
+    def on_recv(cid, msg):
+        got.append(msg)
+        done.set()
+
+    descs = [ChannelDescriptor(id=0x30)]
+    # ~40KB at 20KB/s should take ~1.5-2s (minus the initial burst)
+    ma = MConnection(ca, descs, on_receive=lambda c, m: None,
+                     send_rate=20_000)
+    mb = MConnection(cb, descs, on_receive=on_recv)
+    ma.start(); mb.start()
+    payload = b"R" * 40_000
+    t0 = time.monotonic()
+    ma.send(0x30, payload)
+    assert done.wait(30)
+    dt = time.monotonic() - t0
+    assert got == [payload]
+    assert dt > 1.0, f"40KB at 20KB/s arrived in {dt:.2f}s — unthrottled"
+    ma.stop(); mb.stop()

@@ -86,6 +86,12 @@ def wal2json(path: str, out=sys.stdout) -> int:
 
 
 def json2wal(json_path: str, wal_path: str) -> int:
+    if os.path.exists(wal_path) and os.path.getsize(wal_path):
+        # WAL opens append-mode: writing into an existing log would
+        # KEEP the records being repaired and replay them first
+        raise SystemExit(
+            f"refusing to append to existing non-empty WAL {wal_path}; "
+            f"write to a fresh path and move it into place")
     wal = WAL(wal_path)
     n = 0
     try:
