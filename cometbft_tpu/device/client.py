@@ -87,8 +87,17 @@ class DeviceClient:
             if self._dead is not None:
                 raise ConnectionError(f"device link down: {self._dead}")
             self._pending[req_id] = ev
-            send_frame(self._sock, encode_request(req_id, pubs, msgs,
-                                                  sigs))
+            try:
+                send_frame(self._sock, encode_request(req_id, pubs,
+                                                      msgs, sigs))
+            except OSError as e:
+                # a timed-out/failed send may have written a PARTIAL
+                # frame — the stream is desynchronized; kill the link
+                # so shared_client() reconnects instead of stacking
+                # frames onto garbage
+                self._dead = e
+                self._pending.pop(req_id, None)
+                raise ConnectionError(f"device send failed: {e}") from e
         if not ev.wait(timeout):
             with self._wlock:
                 self._pending.pop(req_id, None)

@@ -13,7 +13,8 @@ import threading
 from typing import List, Optional, Tuple
 
 from ..state.state import State
-from ..types.evidence import (DuplicateVoteEvidence, EvidenceError)
+from ..types.evidence import (DuplicateVoteEvidence, EvidenceError,
+                              LightClientAttackEvidence)
 from ..types.proto import Timestamp
 from ..types.vote import Vote
 
@@ -58,6 +59,64 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, state: State,
             raise EvidenceError("invalid signature on duplicate vote")
 
 
+def verify_light_client_attack(ev: LightClientAttackEvidence,
+                               state: State, common_vals,
+                               trusted_header) -> None:
+    """reference internal/evidence/verify.go:110-160
+    VerifyLightClientAttack.
+
+    common_vals: validator set at ev.common_height (the trust anchor);
+    trusted_header: this node's header at the conflicting height (None
+    if beyond our tip). Raises EvidenceError."""
+    from ..types import validation
+    ev.validate_basic()
+    lb = ev.conflicting_block
+    sh = lb.signed_header
+    # the conflicting header must genuinely diverge from our chain
+    if trusted_header is not None and \
+            trusted_header.hash() == sh.header.hash():
+        raise EvidenceError("conflicting block matches the trusted chain")
+    # 1/3+ of the commonly-trusted set must have signed the conflicting
+    # header (otherwise it could not have fooled a light client)
+    try:
+        validation.verify_commit_light_trusting(
+            state.chain_id, common_vals, sh.commit,
+            validation.Fraction(1, 3))
+    except Exception as e:  # noqa: BLE001 — any verification error
+        raise EvidenceError(
+            f"conflicting commit not signed by 1/3+ of common set: {e}")
+    # for a non-lunatic (equivocation) attack the conflicting block's
+    # own set must also carry 2/3 of it (reference verify.go:139)
+    if trusted_header is not None and \
+            not ev.conflicting_header_is_invalid(trusted_header):
+        try:
+            validation.verify_commit_light(
+                state.chain_id, lb.validator_set, sh.commit.block_id,
+                sh.header.height, sh.commit)
+        except Exception as e:  # noqa: BLE001 — must stay within the
+            # EvidenceError contract: callers (validate_block →
+            # consensus precommit) only convert EvidenceError; anything
+            # else would crash the state machine on a malicious block
+            raise EvidenceError(
+                f"equivocation commit fails 2/3 verification: {e}")
+    if ev.total_voting_power != common_vals.total_voting_power():
+        raise EvidenceError("evidence total power mismatch")
+    # claimed byzantine validators must belong to the common set and
+    # have signed the conflicting commit
+    signers = {cs.validator_address
+               for cs in sh.commit.signatures if cs.for_block()}
+    for val in ev.byzantine_validators:
+        _i, common = common_vals.get_by_address(val.address)
+        if common is None:
+            raise EvidenceError(
+                f"byzantine validator {val.address.hex()[:12]} not in "
+                f"common set")
+        if val.address not in signers:
+            raise EvidenceError(
+                f"byzantine validator {val.address.hex()[:12]} did not "
+                f"sign the conflicting block")
+
+
 class EvidencePool:
     """reference internal/evidence/pool.go Pool."""
 
@@ -85,9 +144,9 @@ class EvidencePool:
         except EvidenceError:
             return None
 
-    def add_evidence(self, ev: DuplicateVoteEvidence, state: State
-                     ) -> Optional[DuplicateVoteEvidence]:
-        """Verify + admit (gossiped or consensus-local)."""
+    def add_evidence(self, ev, state: State):
+        """Verify + admit (gossiped, consensus-local, or detector-made).
+        Accepts DuplicateVoteEvidence and LightClientAttackEvidence."""
         with self._lock:
             key = ev.hash()
             if key in self._seen or key in self._committed:
@@ -97,10 +156,22 @@ class EvidencePool:
                 return None
             if self._expired(ev, state):
                 return None
-            verify_duplicate_vote(ev, state, val_set)
+            self._verify_one(ev, state, val_set)
             self._pending.append(ev)
             self._seen.add(key)
             return ev
+
+    def _verify_one(self, ev, state: State, val_set) -> None:
+        if isinstance(ev, LightClientAttackEvidence):
+            trusted = None
+            if self.block_store is not None:
+                meta = self.block_store.load_block_meta(
+                    ev.conflicting_block.height)
+                if meta is not None:
+                    trusted = meta[1]
+            verify_light_client_attack(ev, state, val_set, trusted)
+        else:
+            verify_duplicate_vote(ev, state, val_set)
 
     def _validators_at(self, height: int, state: State):
         if height == state.last_block_height + 1:
@@ -150,7 +221,7 @@ class EvidencePool:
             if val_set is None:
                 raise EvidenceError(
                     f"no validator set for evidence height {ev.height()}")
-            verify_duplicate_vote(ev, state, val_set)
+            self._verify_one(ev, state, val_set)
 
     def update(self, state: State,
                committed: List[DuplicateVoteEvidence]) -> None:

@@ -32,10 +32,13 @@ class ErrNoWitnesses(LightClientError):
 class ConflictingHeadersError(LightClientError):
     """A witness returned a different header for a verified height — the
     divergence the detector reports as a light-client attack (reference
-    light/detector.go:21-92)."""
+    light/detector.go:21-92). Carries the constructed
+    LightClientAttackEvidence (reference detector.go
+    newLightClientAttackEvidence → provider ReportEvidence)."""
     primary: LightBlock
     witness: LightBlock
     witness_index: int
+    evidence: object = None
 
     def __str__(self) -> str:
         return (f"witness {self.witness_index} disagrees at height "
@@ -207,11 +210,57 @@ class LightClient:
 
     def _cross_check(self, lb: LightBlock) -> None:
         """Compare the verified header against every witness (reference
-        light/detector.go:21-92, compareNewHeaderWithWitness)."""
+        light/detector.go:21-92, compareNewHeaderWithWitness). On
+        divergence, build LightClientAttackEvidence against the highest
+        trusted (common) header below the conflict and report it to the
+        witnesses that can act on it (detector.go ReportEvidence)."""
         for i, w in enumerate(self.witnesses):
             try:
                 other = w.light_block(lb.height)
             except ProviderError:
                 continue  # witness lagging — reference retries/drops
             if other.header.hash() != lb.header.hash():
-                raise ConflictingHeadersError(lb, other, i)
+                # the disputed header must not stay trusted: the verify
+                # strategies saved it before this cross-check ran, and a
+                # stored block short-circuits all future verification
+                self.store.delete(lb.height)
+                # either side may be the attacker: hand each provider
+                # the OTHER side's block as evidence — receivers verify
+                # and drop the half that doesn't check out
+                # (detector.go examines both traces the same way)
+                common = self.store.highest_below(lb.height)
+                ev_witness = self._make_attack_evidence(other, common)
+                ev_primary = self._make_attack_evidence(lb, common)
+                self._report(self.primary, ev_witness)
+                self._report(w, ev_primary)
+                raise ConflictingHeadersError(lb, other, i,
+                                              evidence=ev_witness)
+
+    @staticmethod
+    def _report(provider, evidence) -> None:
+        if evidence is None:
+            return
+        report = getattr(provider, "report_evidence", None)
+        if report is not None:
+            try:
+                report(evidence)
+            except ProviderError:
+                pass
+
+    def _make_attack_evidence(self, conflicting: LightBlock, common):
+        """Evidence anchored at the highest trusted height below the
+        conflict (the common header, detector.go:169)."""
+        from ..types.evidence import LightClientAttackEvidence
+        if common is None:
+            return None
+        signers = {cs.validator_address for cs in
+                   conflicting.signed_header.commit.signatures
+                   if cs.for_block()}
+        byzantine = [v for v in common.validator_set.validators
+                     if v.address in signers]
+        return LightClientAttackEvidence(
+            conflicting_block=conflicting,
+            common_height=common.height,
+            byzantine_validators=byzantine,
+            total_voting_power=common.validator_set.total_voting_power(),
+            timestamp=common.header.time)

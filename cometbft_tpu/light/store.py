@@ -56,6 +56,22 @@ class LightStore:
             return self.light_block(int.from_bytes(k[len(_PREFIX):], "big"))
         return None
 
+    def highest_below(self, height: int) -> Optional[LightBlock]:
+        """The highest trusted block with height < `height` (one ordered
+        key scan, not per-height gets — the detector's common-anchor
+        lookup)."""
+        last = None
+        for k, _v in self._db.iterate(_PREFIX, _key(height)):
+            last = k
+        if last is None:
+            return None
+        return self.light_block(int.from_bytes(last[len(_PREFIX):], "big"))
+
+    def delete(self, height: int) -> None:
+        """Evict a block (a detected-attack header must not stay
+        trusted)."""
+        self._db.delete(_key(height))
+
     def prune(self, keep: int) -> None:
         """Keep the `keep` highest blocks (reference db.go Prune)."""
         keys = [k for k, _ in self._db.iterate(_PREFIX, _END)]

@@ -181,12 +181,15 @@ class Switch:
 
     def stop_peer(self, peer: Peer, reason: str,
                   ban: bool = False) -> None:
-        """reference switch.go StopPeerForError."""
+        """reference switch.go StopPeerForError (persistent peers are
+        never banned — a single transient reactor error must not cut a
+        configured link forever; the reference reconnects them too,
+        switch.go:222 isPersistent check)."""
         with self._lock:
             if self._peers.get(peer.id) is not peer:
                 return
             del self._peers[peer.id]
-            if ban:
+            if ban and peer.id not in self._persistent.values():
                 self.banned.add(peer.id)
         peer.stop()
         for r in self._reactors:

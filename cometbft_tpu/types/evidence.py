@@ -113,12 +113,118 @@ class DuplicateVoteEvidence:
                 f"h{self.vote_a.height}/r{self.vote_a.round}}}")
 
 
+@dataclass(frozen=True)
+class ByzantineRef:
+    """Address-only stand-in for a byzantine validator the (attacker-
+    controlled) conflicting validator set does not list — wire decode
+    must preserve every claimed address for hash stability."""
+    address: bytes
+
+
+@dataclass
+class LightClientAttackEvidence:
+    """A conflicting light block signed by validators who were trusted
+    at common_height (reference types/evidence.go:155-263
+    LightClientAttackEvidence) — what the light client's witness
+    detector produces on header divergence (light/detector.go)."""
+    conflicting_block: object            # light.types.LightBlock
+    common_height: int
+    byzantine_validators: List = dc_field(default_factory=list)
+    total_voting_power: int = 0
+    timestamp: Timestamp = dc_field(default_factory=Timestamp)
+
+    def abci_kind(self) -> str:
+        return "LIGHT_CLIENT_ATTACK"
+
+    def height(self) -> int:
+        return self.common_height
+
+    def time(self) -> Timestamp:
+        return self.timestamp
+
+    def addresses(self) -> List[bytes]:
+        return [v.address for v in self.byzantine_validators]
+
+    def conflicting_header_is_invalid(self, trusted_header) -> bool:
+        """Lunatic attack iff the conflicting header's derived fields
+        differ from the trusted chain's (reference evidence.go:178)."""
+        h, t = self.conflicting_block.header, trusted_header
+        return (h.validators_hash != t.validators_hash
+                or h.next_validators_hash != t.next_validators_hash
+                or h.consensus_hash != t.consensus_hash
+                or h.app_hash != t.app_hash
+                or h.last_results_hash != t.last_results_hash)
+
+    def encode(self) -> bytes:
+        from ..state.state import _valset_to_json
+        lb = self.conflicting_block
+        blk = (proto.f_embed(1, lb.signed_header.header.encode())
+               + proto.f_embed(2, lb.signed_header.commit.encode())
+               + proto.f_bytes(3, _valset_to_json(lb.validator_set)))
+        body = (proto.f_embed(1, blk)
+                + proto.f_varint(2, self.common_height)
+                + proto.f_varint(3, self.total_voting_power)
+                + proto.f_embed(4, self.timestamp.encode())
+                + b"".join(proto.f_bytes(
+                    5, v.address) for v in self.byzantine_validators))
+        return proto.f_embed(2, body)  # oneof slot 2
+
+    @classmethod
+    def decode_body(cls, body: bytes) -> "LightClientAttackEvidence":
+        from ..light.types import LightBlock, SignedHeader
+        from ..state.state import _valset_from_json
+        from .block import Commit, Header
+        f = proto.parse_fields(body)
+        bf = proto.parse_fields(proto.field_bytes(f, 1, b""))
+        lb = LightBlock(
+            SignedHeader(Header.decode(proto.field_bytes(bf, 1, b"")),
+                         Commit.decode(proto.field_bytes(bf, 2, b""))),
+            _valset_from_json(proto.field_bytes(bf, 3, b"")))
+        ts = proto.field_bytes(f, 4, None)
+        ev = cls(conflicting_block=lb,
+                 common_height=proto.to_int64(proto.field_int(f, 2, 0)),
+                 total_voting_power=proto.to_int64(
+                     proto.field_int(f, 3, 0)),
+                 timestamp=(Timestamp.decode(ts) if ts is not None
+                            else Timestamp()))
+        # byzantine entries resolved against the conflicting block's set
+        # when present, else kept as bare address refs — the set is
+        # ATTACKER-CONTROLLED and may omit them; dropping entries would
+        # change the hash across a wire round-trip and break dedup
+        for addr in proto.field_all_bytes(f, 5):
+            _i, val = lb.validator_set.get_by_address(addr)
+            ev.byzantine_validators.append(
+                val if val is not None else ByzantineRef(addr))
+        return ev
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.encode()).digest()
+
+    def validate_basic(self) -> None:
+        """reference types/evidence.go ValidateABCI/ValidateBasic."""
+        if self.conflicting_block is None:
+            raise EvidenceError("missing conflicting block")
+        if self.common_height <= 0:
+            raise EvidenceError("non-positive common height")
+        if self.common_height > self.conflicting_block.height:
+            raise EvidenceError("common height above conflicting block")
+        self.conflicting_block.signed_header.commit.validate_basic()
+
+    def __repr__(self) -> str:
+        return (f"LightClientAttackEvidence{{common:{self.common_height} "
+                f"conflict:{self.conflicting_block.height} "
+                f"byz:{len(self.byzantine_validators)}}}")
+
+
 def decode_evidence(buf: bytes):
     """Evidence oneof decoder."""
     f = proto.parse_fields(buf)
     dv = proto.field_bytes(f, 1, None)
     if dv is not None:
         return DuplicateVoteEvidence.decode_body(dv)
+    lc = proto.field_bytes(f, 2, None)
+    if lc is not None:
+        return LightClientAttackEvidence.decode_body(lc)
     raise ValueError("unknown evidence kind")
 
 

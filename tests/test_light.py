@@ -162,3 +162,99 @@ def test_light_store_prune(chain):
     store.prune(3)
     assert store.lowest().height == chain.max_height() - 2
     assert store.latest().height == chain.max_height()
+
+
+def test_light_client_attack_evidence(chain):
+    """A properly-signed lunatic fork from 2/5 of the validators is
+    detected by the witness cross-check, packaged as
+    LightClientAttackEvidence, reported to providers, and verifies
+    against the common validator set (reference light/detector.go +
+    internal/evidence/verify.go:110 VerifyLightClientAttack)."""
+    from dataclasses import replace
+
+    from cometbft_tpu.engine.chain_gen import sign_commit
+    from cometbft_tpu.evidence.pool import verify_light_client_attack
+    from cometbft_tpu.types.block import BlockID
+    from cometbft_tpu.types.evidence import (EvidenceError,
+                                             LightClientAttackEvidence)
+
+    target = chain.max_height()
+    real = chain.blocks[target - 1]
+    vals = chain.valsets[target - 1]
+
+    # forge: lunatic header (wrong app hash) signed by a 2/5 subset of
+    # the real validator set (>= 1/3 of common power)
+    forged_hdr = replace(real.header, app_hash=b"\x66" * 32)
+    forged = replace(real, header=forged_hdr)
+    byz = vals.validators[:2]
+    byz_keys = {v.address: chain.keys[v.address] for v in byz}
+    fid = BlockID(forged.hash(), forged.make_part_set().header)
+
+    class _SubsetVals:
+        validators = byz
+
+    forged_commit = sign_commit(chain.chain_id, target, 0, fid,
+                                _SubsetVals, byz_keys)
+    forged_lb = LightBlock(SignedHeader(forged_hdr, forged_commit),
+                           vals.copy())
+
+    class ForgingProvider(ChainProvider):
+        def __init__(self, chain):
+            super().__init__(chain)
+            self.reported = []
+
+        def light_block(self, height):
+            if height in (0, target):
+                return forged_lb
+            return super().light_block(height)
+
+        def report_evidence(self, ev):
+            self.reported.append(ev)
+
+    honest = ChainProvider(chain)
+    honest.reported = []
+    honest.report_evidence = honest.reported.append
+    witness = ForgingProvider(chain)
+    lc = _client(chain, witnesses=[witness])
+    with pytest.raises(ConflictingHeadersError) as exc_info:
+        lc.verify_light_block_at_height(target)
+    ev = exc_info.value.evidence
+    assert isinstance(ev, LightClientAttackEvidence)
+    assert ev.conflicting_block.header.app_hash == b"\x66" * 32
+    assert ev.common_height < target
+    assert {v.address for v in ev.byzantine_validators} == \
+        {v.address for v in byz}
+
+    # wire round-trip
+    from cometbft_tpu.types.evidence import decode_evidence
+    ev2 = decode_evidence(ev.encode())
+    assert ev2.hash() == ev.hash()
+    assert ev2.common_height == ev.common_height
+
+    # verification against the common set: valid forged commit passes...
+    from cometbft_tpu.state.state import State
+    from cometbft_tpu.state.state import GenesisDoc
+    state = State.from_genesis(chain.genesis)
+    common_vals = chain.valsets[ev.common_height - 1]
+    verify_light_client_attack(ev, state, common_vals, real.header)
+
+    # ...but evidence whose conflicting commit lacks 1/3 of the common
+    # power is rejected
+    lone = vals.validators[:1]
+    lone_keys = {lone[0].address: chain.keys[lone[0].address]}
+
+    class _OneVal:
+        validators = lone
+
+    weak_commit = sign_commit(chain.chain_id, target, 0, fid,
+                              _OneVal, lone_keys)
+    weak_ev = LightClientAttackEvidence(
+        conflicting_block=LightBlock(SignedHeader(forged_hdr, weak_commit),
+                                     vals.copy()),
+        common_height=ev.common_height,
+        byzantine_validators=lone,
+        total_voting_power=common_vals.total_voting_power(),
+        timestamp=ev.timestamp)
+    with pytest.raises(EvidenceError):
+        verify_light_client_attack(weak_ev, state, common_vals,
+                                   real.header)
