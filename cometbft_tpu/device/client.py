@@ -94,9 +94,16 @@ class DeviceClient:
                 # a timed-out/failed send may have written a PARTIAL
                 # frame — the stream is desynchronized; kill the link
                 # so shared_client() reconnects instead of stacking
-                # frames onto garbage
+                # frames onto garbage. Closing the socket wakes the
+                # recv routine, which fails every OTHER in-flight
+                # waiter immediately (they'd otherwise sit out their
+                # full timeouts on responses that can never parse).
                 self._dead = e
                 self._pending.pop(req_id, None)
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
                 raise ConnectionError(f"device send failed: {e}") from e
         if not ev.wait(timeout):
             with self._wlock:

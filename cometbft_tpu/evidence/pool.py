@@ -61,17 +61,31 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, state: State,
 
 def verify_light_client_attack(ev: LightClientAttackEvidence,
                                state: State, common_vals,
-                               trusted_header) -> None:
+                               trusted_header,
+                               common_time=None) -> None:
     """reference internal/evidence/verify.go:110-160
     VerifyLightClientAttack.
 
     common_vals: validator set at ev.common_height (the trust anchor);
     trusted_header: this node's header at the conflicting height (None
-    if beyond our tip). Raises EvidenceError."""
+    if beyond our tip); common_time: the committed block time at
+    common_height when known. Raises EvidenceError."""
     from ..types import validation
     ev.validate_basic()
     lb = ev.conflicting_block
     sh = lb.signed_header
+    if common_time is not None and ev.timestamp != common_time:
+        # the timestamp is hashed: left unpinned, re-gossiping the same
+        # attack with fresh timestamps would mint unlimited new hashes
+        # (dedup bypass) and a future timestamp would never expire
+        raise EvidenceError(
+            "evidence timestamp does not match the common block time")
+    if lb.validator_set.hash() != sh.header.validators_hash:
+        # the 2/3 equivocation check below runs against this set; an
+        # inconsistent (freely attacker-chosen) set would make it
+        # vacuous (reference validates the conflicting block first)
+        raise EvidenceError(
+            "conflicting block validator set does not match its header")
     # the conflicting header must genuinely diverge from our chain
     if trusted_header is not None and \
             trusted_header.hash() == sh.header.hash():
@@ -164,12 +178,18 @@ class EvidencePool:
     def _verify_one(self, ev, state: State, val_set) -> None:
         if isinstance(ev, LightClientAttackEvidence):
             trusted = None
+            common_time = None
             if self.block_store is not None:
                 meta = self.block_store.load_block_meta(
                     ev.conflicting_block.height)
                 if meta is not None:
                     trusted = meta[1]
-            verify_light_client_attack(ev, state, val_set, trusted)
+                common_meta = self.block_store.load_block_meta(
+                    ev.common_height)
+                if common_meta is not None:
+                    common_time = common_meta[1].time
+            verify_light_client_attack(ev, state, val_set, trusted,
+                                       common_time=common_time)
         else:
             verify_duplicate_vote(ev, state, val_set)
 

@@ -224,17 +224,37 @@ class LightClient:
                 # strategies saved it before this cross-check ran, and a
                 # stored block short-circuits all future verification
                 self.store.delete(lb.height)
-                # either side may be the attacker: hand each provider
-                # the OTHER side's block as evidence — receivers verify
-                # and drop the half that doesn't check out
-                # (detector.go examines both traces the same way)
-                common = self.store.highest_below(lb.height)
+                # the anchor must be a header BOTH sides share — recent
+                # stored headers came from the (possibly lying) primary,
+                # so walk down until the witness agrees, evicting every
+                # primary-only header passed on the way (the reference
+                # detector walks its trace the same way,
+                # detector.go examineConflictingHeaderAgainstTrace)
+                common = self._common_anchor(w, lb.height)
                 ev_witness = self._make_attack_evidence(other, common)
                 ev_primary = self._make_attack_evidence(lb, common)
                 self._report(self.primary, ev_witness)
                 self._report(w, ev_primary)
                 raise ConflictingHeadersError(lb, other, i,
                                               evidence=ev_witness)
+
+    def _common_anchor(self, witness: Provider,
+                       below: int) -> Optional[LightBlock]:
+        """Highest stored block below `below` whose hash the witness
+        confirms; stored blocks the witness disputes (headers only the
+        primary vouched for) are evicted rather than trusted."""
+        while True:
+            cand = self.store.highest_below(below)
+            if cand is None:
+                return None
+            try:
+                theirs = witness.light_block(cand.height)
+                if theirs.header.hash() == cand.header.hash():
+                    return cand
+            except ProviderError:
+                return cand  # witness can't say; keep the stored anchor
+            self.store.delete(cand.height)
+            below = cand.height
 
     @staticmethod
     def _report(provider, evidence) -> None:

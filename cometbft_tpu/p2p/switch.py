@@ -148,9 +148,12 @@ class Switch:
                     continue
                 pid = self._persistent.get(addr, "")
                 if pid and pid in self.banned:
-                    # a banned peer would complete the whole handshake
-                    # just to be closed — don't churn crypto forever
-                    continue
+                    # a configured persistent peer overrides a ban (it
+                    # can be banned before we learn its id, e.g. when
+                    # it connected inbound first and tripped a reactor
+                    # error) — unban and reconnect; transient errors
+                    # must not cut a configured link forever
+                    self.banned.discard(pid)
                 try:
                     self.dial(*addr)
                 except OSError:
