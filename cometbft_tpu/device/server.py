@@ -68,7 +68,13 @@ class DeviceServer:
         from ..crypto import ref_ed25519 as ref
         pub = ref.pubkey_from_seed(seed)
         sig = ref.sign(seed, b"warm")
-        bad = bytes([sig[0] ^ 1]) + sig[1:]
+        # corrupt a LOW byte of s (offset 32..63): the signature stays
+        # structurally valid so the RLC batch EQUATION fails and the
+        # per-lane fallback actually compiles. (Corrupting R made the
+        # lane fail at decompression — struct_ok already attributes
+        # that without the fallback, which then first compiled minutes
+        # into a live commit verification.)
+        bad = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
         verify_batch([pub], [b"warm"], [sig], batch_size=self.bucket)
         verify_batch([pub], [b"warm"], [bad], batch_size=self.bucket)
 

@@ -30,7 +30,36 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
     current = jax.config.jax_platforms
     if plat and (not current or "," in current):
         jax.config.update("jax_platforms", plat)
+        current = plat
+    # the persistent cache is TPU-only: XLA:CPU AOT executables record
+    # machine features that fail the host check when another process
+    # reloads them ("could lead to SIGILL" — and mesh executables DO
+    # segfault, in both the serialize and deserialize paths). Enable
+    # only when the FIRST configured platform is explicitly a
+    # non-cpu device; anything undetermined could resolve to the CPU
+    # backend, so stay conservative and recompile per process.
+    first = (current or "").split(",")[0]
+    if first in ("", "cpu"):
+        disable_persistent_cache()
+        return
     jax.config.update(
         "jax_compilation_cache_dir",
         cache_dir or os.path.join(_REPO_ROOT, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def disable_persistent_cache() -> None:
+    """Turn the on-disk compile cache off for the rest of the process.
+
+    The flag alone is NOT enough once anything has compiled: jax
+    memoizes the is-cache-enabled decision globally at first compile,
+    so the memo must be reset too (observed: a process that compiled
+    plenty beforehand still cache-WROTE a sharded executable — and
+    segfaulted serializing it — despite the flag being False)."""
+    import jax
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — internal API; flag still set
+        pass

@@ -63,19 +63,16 @@ def sharded_commit_verify(mesh: Mesh, pub: jnp.ndarray, sig: jnp.ndarray,
     return fn(pub, sig, hblocks, hnblocks, power)
 
 
-def _disable_persistent_cache_for_process() -> None:
-    """Deserializing a MULTI-device sharded executable written by
-    another process segfaults this jaxlib build (single-device entries
-    round-trip fine), so the first mesh use turns the on-disk compile
-    cache off for the REST OF THE PROCESS — a one-way, race-free switch
-    (toggling it back around calls would race other threads' compiles
-    and could re-admit the poisonous entries)."""
-    jax.config.update("jax_enable_compilation_cache", False)
-
-
 def make_sharded_verifier(mesh: Mesh, zip215: bool = True):
-    """jit-compiled closure over the mesh (one compile per tile shape)."""
-    _disable_persistent_cache_for_process()
+    """jit-compiled closure over the mesh (one compile per tile shape).
+
+    Mesh use turns the on-disk compile cache off for the rest of the
+    process: SERIALIZING or deserializing a MULTI-device sharded
+    executable in the persistent cache segfaults this jaxlib build —
+    a one-way, race-free switch (toggling it back around calls would
+    race other threads' compiles and re-admit the poisonous entries)."""
+    from ..libs.jax_cache import disable_persistent_cache
+    disable_persistent_cache()
 
     @jax.jit
     def run(pub, sig, hblocks, hnblocks, power):

@@ -18,8 +18,11 @@ import jax
 
 # jax is pre-imported by the ambient environment (sitecustomize), so env
 # vars are latched before this file runs — ALL config must go through
-# jax.config, including the persistent compile cache (without it every
-# test run recompiles the kernels from scratch, minutes per variant).
+# jax.config. NOTE: on the CPU test platform enable_compile_cache()
+# intentionally DISABLES the persistent compile cache — XLA:CPU AOT
+# executables reloaded by another process fail the machine-feature
+# check (SIGILL risk; mesh executables outright segfault), so every
+# test run recompiles its kernels (minutes per variant, per process).
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
