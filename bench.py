@@ -6,20 +6,99 @@ signatures, matching blocksync catch-up with a 200-validator set
 (reference internal/blocksync/reactor.go:483, baseline ~78k sigs/s CPU
 batch-1024, docs/references/rfc/tendermint-core/rfc-018:187-189).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Env knobs: BENCH_BATCH (default 4096), BENCH_ITERS (default 4).
+Prints ONE JSON line on stdout: {"metric", "value", "unit",
+"vs_baseline"}; all diagnostics/progress go to stderr. The TPU tunnel in
+this environment is single-client and can wedge indefinitely at backend
+init, so backend liveness is probed in a THROWAWAY SUBPROCESS with a
+hard timeout first (retrying once); a wedged tunnel fails fast with a
+diagnostic instead of hanging for 10 silent minutes.
+
+Env knobs: BENCH_BATCH (default 8192), BENCH_ITERS (default 4),
+BENCH_PROBE_TIMEOUT (s, default 75), BENCH_ALLOW_CPU=1 (measure on the
+CPU backend instead of failing when no TPU — for local dev only; the
+JSON then carries "backend": "cpu").
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
+
+# XLA's HLO passes recurse deeply on the RLC kernel graph: at the
+# default 8MB thread stack the batch-4096 compile OVERFLOWS (observed:
+# SIGSEGV at the stack guard, dmesg "error 6" inside libjax_common).
+# pthread stacks size themselves from RLIMIT_STACK at thread creation,
+# so raise it before anything builds a compiler thread pool.
+try:
+    import resource
+    _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    _want = 512 * 1024 * 1024
+    if _hard != resource.RLIM_INFINITY:
+        _want = min(_want, _hard)
+    if _soft != resource.RLIM_INFINITY and _soft < _want:
+        resource.setrlimit(resource.RLIMIT_STACK, (_want, _hard))
+except (ImportError, ValueError, OSError):  # pragma: no cover
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from cometbft_tpu.libs.jax_cache import enable_compile_cache  # noqa: E402
 
 BASELINE_SIGS_PER_SEC = 78_000.0  # CPU curve25519-voi, 1024-sig batches
+
+_PROBE_CODE = """
+import sys, os
+sys.path.insert(0, {root!r})
+from cometbft_tpu.libs.jax_cache import enable_compile_cache
+enable_compile_cache()
+import jax
+ds = jax.devices()
+print("PROBE", ds[0].platform, len(ds), flush=True)
+"""
+
+
+def _log(msg):
+    print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.monotonic()
+
+
+def probe_backend():
+    """Liveness-check backend init in a subprocess with a hard timeout.
+
+    Returns the device platform string ("axon"/"tpu"/"cpu"/...) or None
+    if init hung or failed both attempts. The subprocess exits before we
+    return, so the single-client tunnel is free for the real run.
+    """
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
+    code = _PROBE_CODE.format(root=os.path.dirname(os.path.abspath(__file__)))
+    last = ""
+    for attempt in (1, 2):
+        _log(f"probing jax backend (attempt {attempt}/2, "
+             f"timeout {timeout:.0f}s)...")
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout)
+        except subprocess.TimeoutExpired:
+            last = (f"backend init HUNG >{timeout:.0f}s — the TPU tunnel "
+                    f"is wedged (single-client; nothing in-repo can reset "
+                    f"it). Retrying once.")
+            _log(last)
+            continue
+        for line in r.stdout.splitlines():
+            if line.startswith("PROBE "):
+                _, platform, n = line.split()
+                _log(f"backend alive: platform={platform} devices={n}")
+                return platform
+        last = (f"backend init FAILED rc={r.returncode}: "
+                f"{(r.stderr or r.stdout).strip().splitlines()[-1:] or ['?']}")
+        _log(last)
+    _log(f"backend unavailable after 2 attempts: {last}")
+    return None
 
 
 def _gen_signatures(n, n_validators=200, msg_len=122, seed=7):
@@ -58,17 +137,18 @@ def _gen_signatures(n, n_validators=200, msg_len=122, seed=7):
     return pubs, msgs, sigs
 
 
-def main():
+def measure(batch, iters):
+    """Time the RLC kernel on the already-initialized default backend.
+
+    Returns (sigs_per_sec, compile_secs)."""
     import numpy as np
     import jax
-    enable_compile_cache()
     from cometbft_tpu.ops.ed25519 import (
         verify_rlc_kernel, prepare_batch, make_rlc_coefficients)
 
-    batch = int(os.environ.get("BENCH_BATCH", "8192"))
-    iters = int(os.environ.get("BENCH_ITERS", "4"))
-
+    _log(f"generating {batch} signatures (200-validator set)...")
     pubs, msgs, sigs = _gen_signatures(batch)
+    _log("packing batch...")
     pub, sig, hb, hn, ok_mask = prepare_batch(pubs, msgs, sigs, batch, 128)
     assert ok_mask.all()
     dev = jax.devices()[0]
@@ -76,26 +156,104 @@ def main():
 
     # the production fast path: one random-linear-combination equation per
     # tile (fresh coefficients every flush, as the verifier requires)
+    _log("compiling + warming RLC kernel (first compile can take "
+         "tens of seconds; persistent cache is on for TPU)...")
+    tc = time.monotonic()
     z = make_rlc_coefficients(batch)
     bok, sok = verify_rlc_kernel(pub, sig, hb, hn, z)  # compile + warm
+    compile_secs = time.monotonic() - tc
     assert bool(bok) and np.asarray(sok).all(), "warmup verification failed"
+    _log(f"warm in {compile_secs:.1f}s; timing {iters} iterations...")
 
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         z = make_rlc_coefficients(batch)
         bok, out = verify_rlc_kernel(pub, sig, hb, hn, z)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     assert bool(bok)
+    _log(f"{iters} x {batch} sigs in {dt:.3f}s")
+    return batch * iters / dt, compile_secs
 
-    sigs_per_sec = batch * iters / dt
-    print(json.dumps({
+
+def _measure_mode(batch: int, iters: int) -> int:
+    """Child process: init backend, compile, measure, print ONE JSON
+    line. Isolated so a compiler crash (XLA is known to SIGSEGV — stack
+    overflow — building `verify_rlc_core` at large batch on some
+    backends, see docs/PERF.md) kills only this process and the parent
+    can retry a smaller batch against the now-warm compile cache."""
+    enable_compile_cache()
+    import jax
+    dev = jax.devices()[0]
+    _log(f"measure[{batch}]: devices: {jax.devices()}")
+    sigs_per_sec, _compile = measure(batch, iters)
+    rec = {
         "metric": "ed25519_batch_verify_throughput",
         "value": round(sigs_per_sec, 1),
         "unit": "sigs/s",
         "vs_baseline": round(sigs_per_sec / BASELINE_SIGS_PER_SEC, 3),
-    }))
+        "batch": batch,
+    }
+    if dev.platform == "cpu":
+        rec["backend"] = "cpu"
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "8192"))
+    iters = int(os.environ.get("BENCH_ITERS", "4"))
+    allow_cpu = os.environ.get("BENCH_ALLOW_CPU") == "1"
+    measure_timeout = float(os.environ.get("BENCH_MEASURE_TIMEOUT", "1500"))
+
+    platform = probe_backend()
+    if platform is None:
+        print("bench: FATAL: jax backend unavailable (TPU tunnel wedged "
+              "or init failing — see probe log above). Refusing to hang; "
+              "see docs/PERF.md for the last recorded measurement.",
+              file=sys.stderr, flush=True)
+        return 1
+    if platform == "cpu" and not allow_cpu:
+        print("bench: FATAL: only the CPU backend is available and "
+              "BENCH_ALLOW_CPU!=1 — the headline metric is a TPU number; "
+              "refusing to publish a CPU measurement as if it were one. "
+              "See docs/PERF.md for the last recorded TPU measurement.",
+              file=sys.stderr, flush=True)
+        return 1
+
+    # measurement runs in a child per batch attempt: a compiler crash
+    # falls back to the next smaller batch (the RLC equation amortizes
+    # fully well before 1k lanes, so smaller tiles remain a fair
+    # measurement), and a hang is bounded by the timeout
+    attempts = []
+    for b in (batch, batch // 4, 1024, 256, 64):
+        if b >= 1 and b not in attempts:
+            attempts.append(b)
+    for b in attempts:
+        _log(f"measuring batch={b} in a subprocess "
+             f"(timeout {measure_timeout:.0f}s)...")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--measure", str(b), str(iters)],
+                capture_output=True, text=True, timeout=measure_timeout)
+        except subprocess.TimeoutExpired:
+            _log(f"measure[{b}] timed out; not retrying larger work")
+            return 1
+        sys.stderr.write(r.stderr)
+        line = next((ln for ln in r.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if r.returncode == 0 and line:
+            print(line, flush=True)
+            return 0
+        _log(f"measure[{b}] failed rc={r.returncode} "
+             f"(signal={-r.returncode if r.returncode < 0 else 'none'});"
+             f" retrying smaller batch")
+    _log("all batch sizes failed")
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure":
+        sys.exit(_measure_mode(int(sys.argv[2]), int(sys.argv[3])))
+    sys.exit(main())

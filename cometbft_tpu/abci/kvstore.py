@@ -39,6 +39,10 @@ class KVStoreApplication(BaseApplication):
         # reader on the RPC thread can't tear (state, height) apart
         # while commit() swaps them on the consensus thread.
         self._prev: tuple | None = None
+        # height -> captured snapshot blob; replaced wholesale (never
+        # mutated in place) so snapshot-connection readers see a
+        # consistent dict without locks
+        self._snapshot_blobs: dict = {}
 
     # --- helpers -------------------------------------------------------------
 
@@ -155,6 +159,20 @@ class KVStoreApplication(BaseApplication):
             self._prev = (self.state, self.last_height - 1)
             self.state = self.staged
             self.staged = None
+            if self.last_height % self.SNAPSHOT_INTERVAL == 0:
+                # capture an interval snapshot (reference kvstore.go
+                # snapshot_interval): advertising the live tip instead
+                # would race the restorer's light anchor — header H+1
+                # doesn't exist yet when the snapshot IS the tip, and
+                # re-discovery would chase the tip forever.
+                # Copy-on-write + single assignment: the snapshot
+                # connection reads this dict from another thread (same
+                # no-tear discipline as _prev above)
+                blobs = dict(self._snapshot_blobs)
+                blobs[self.last_height] = self._snapshot_blob()
+                for h in sorted(blobs)[:-self.SNAPSHOT_KEEP]:
+                    del blobs[h]
+                self._snapshot_blobs = blobs
         return ResponseCommit(retain_height=0)
 
     def query(self, path: str, data: bytes) -> tuple[int, bytes]:
@@ -181,17 +199,54 @@ class KVStoreApplication(BaseApplication):
         v = prev_state.get(key)
         if v is None or key.encode() != data:
             # second clause: a lossily-decoded (invalid UTF-8) query can
-            # alias a stored key; its leaf bytes would not match `data`
-            return CODE_TYPE_OK, b"", prev_height, None
+            # alias a stored key; byte-level bracketing below still
+            # proves `data` itself is absent from the leaf set
+            return (CODE_TYPE_OK, b"", prev_height,
+                    self._absence_proof(prev_state, prev_height, data))
         value = v.encode()
         leaves = self._state_leaves(prev_state, prev_height)
         idx = leaves.index(self.kv_leaf(data, value))
         _root, proofs = merkle.proofs_from_byte_slices(leaves)
         return CODE_TYPE_OK, value, prev_height, proofs[idx]
 
+    @classmethod
+    def _absence_proof(cls, state: dict, height: int, data: bytes
+                       ) -> merkle.AbsenceProof:
+        """Prove `data` is NOT a key: inclusion of the two adjacent
+        leaves bracketing its sorted position. The height leaf at index
+        0 is the left sentinel (every kv key sorts after it); a missing
+        right neighbor is provable because Proof.total pins the tree
+        size. UTF-8 preserves code-point order, so the str sort of
+        `_state_leaves` and the byte-level bisect here agree."""
+        import bisect
+        ekeys = [k.encode() for k in sorted(state)]
+        pos = bisect.bisect_left(ekeys, data)  # count of keys < data
+        leaves = cls._state_leaves(state, height)
+        _root, proofs = merkle.proofs_from_byte_slices(leaves)
+        li = pos               # kv leaf j sits at tree index j+1
+        ri = pos + 1 if pos < len(ekeys) else None
+        return merkle.AbsenceProof(
+            proofs[li], leaves[li],
+            proofs[ri] if ri is not None else None,
+            leaves[ri] if ri is not None else None)
+
+    @staticmethod
+    def parse_kv_leaf(leaf: bytes) -> Optional[Tuple[bytes, bytes]]:
+        """(key, value) from a kv_leaf, or None if not one (e.g. the
+        height sentinel leaf). Inverse of `kv_leaf` — used by verifying
+        clients to check absence-proof neighbors bracket the query."""
+        if len(leaf) < 5 or leaf[0] != 0x01:
+            return None
+        klen = int.from_bytes(leaf[1:5], "big")
+        if len(leaf) < 5 + klen:
+            return None
+        return leaf[5:5 + klen], leaf[5 + klen:]
+
     # --- statesync snapshots (reference kvstore.go snapshot support) ---------
 
     SNAPSHOT_CHUNK_SIZE = 1 << 16
+    SNAPSHOT_INTERVAL = 5   # capture every N commits (kvstore.go analog)
+    SNAPSHOT_KEEP = 2       # retain the most recent K interval snapshots
 
     def _snapshot_blob(self) -> bytes:
         return json.dumps({"state": {k: self.state[k]
@@ -200,25 +255,29 @@ class KVStoreApplication(BaseApplication):
                           separators=(",", ":")).encode()
 
     def list_snapshots(self) -> List[Snapshot]:
-        """One snapshot of the current committed state, with its blob
-        CAPTURED at advertise time — chunks must stay byte-stable while
-        later blocks commit, or the restorer's hash check fails (the
-        reference snapshots to disk on an interval for the same reason).
-        """
+        """The retained interval snapshots, blobs captured at commit
+        time — chunks must stay byte-stable while later blocks commit,
+        or the restorer's hash check fails. A consensus-idle app (tests
+        driving apply_block by hand) that never crossed an interval
+        falls back to capturing its current committed state."""
         if self.last_height == 0:
             return []
-        blob = self._snapshot_blob()
-        if not hasattr(self, "_snapshot_blobs"):
-            self._snapshot_blobs = {}
-        self._snapshot_blobs[self.last_height] = blob
-        n = max(1, (len(blob) + self.SNAPSHOT_CHUNK_SIZE - 1)
-                // self.SNAPSHOT_CHUNK_SIZE)
-        return [Snapshot(height=self.last_height, format=1, chunks=n,
-                         hash=hashlib.sha256(blob).digest())]
+        blobs = self._snapshot_blobs  # atomic ref: see commit()
+        if not blobs:
+            blobs = {self.last_height: self._snapshot_blob()}
+            self._snapshot_blobs = blobs
+        out = []
+        for h in sorted(blobs, reverse=True):
+            blob = blobs[h]
+            n = max(1, (len(blob) + self.SNAPSHOT_CHUNK_SIZE - 1)
+                    // self.SNAPSHOT_CHUNK_SIZE)
+            out.append(Snapshot(height=h, format=1, chunks=n,
+                                hash=hashlib.sha256(blob).digest()))
+        return out
 
     def load_snapshot_chunk(self, height: int, format_: int,
                             chunk: int) -> bytes:
-        blob = getattr(self, "_snapshot_blobs", {}).get(height)
+        blob = self._snapshot_blobs.get(height)
         if blob is None:
             return b""  # unknown snapshot: restorer will RETRY elsewhere
         lo = chunk * self.SNAPSHOT_CHUNK_SIZE

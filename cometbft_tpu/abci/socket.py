@@ -179,13 +179,12 @@ class ABCIServer:
             code, value = app.query(b["path"], _unhx(b["data"]))
             return {"code": code, "value": _hx(value)}
         if method == _M_QUERY_PROVE:
+            from ..rpc.codec import proof_json
             code, value, height, pf = app.query_prove(
                 b["path"], _unhx(b["data"]))
             out = {"code": code, "value": _hx(value), "height": height}
             if pf is not None:
-                out["proof"] = {"total": pf.total, "index": pf.index,
-                                "leaf_hash": _hx(pf.leaf_hash),
-                                "aunts": [_hx(a) for a in pf.aunts]}
+                out["proof"] = proof_json(pf)
             return out
         raise ValueError(f"unknown ABCI method {method}")
 
@@ -284,12 +283,10 @@ class SocketClient:
         return r["code"], _unhx(r["value"])
 
     def query_prove(self, path: str, data: bytes):
-        from ..crypto.merkle import Proof
+        from ..rpc.codec import proof_from_json
         r = self._call(_M_QUERY_PROVE, {"path": path, "data": _hx(data)})
-        pf = r.get("proof")
-        proof = Proof(pf["total"], pf["index"], _unhx(pf["leaf_hash"]),
-                      [_unhx(a) for a in pf["aunts"]]) if pf else None
-        return r["code"], _unhx(r["value"]), r["height"], proof
+        return (r["code"], _unhx(r["value"]), r["height"],
+                proof_from_json(r.get("proof")))
 
     def close(self) -> None:
         try:

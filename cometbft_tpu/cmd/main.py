@@ -152,6 +152,36 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_bootstrap_state(args) -> int:
+    """Offline state bootstrap (reference node/node.go:152
+    BootstrapState + commands/bootstrap_state.go): with the node
+    STOPPED, fetch a light-verified state at --height from the
+    [statesync] rpc_servers and write it (plus the seen commit) into
+    the stores, so the next `start` continues from there without
+    replaying history. The app must separately hold matching state
+    (e.g. restored from its own snapshot/backup)."""
+    from ..db.kv import open_db
+    from ..node.node import load_genesis
+    from ..state.state import StateStore
+    from ..statesync.stateprovider import light_provider_from_config
+    from ..store.blockstore import BlockStore
+    cfg = _cfg(args.home)
+    ss_cfg = cfg.statesync
+    ss_cfg.enable = True  # reuse its validation for the trust anchor
+    ss_cfg.validate_basic()
+    gen = load_genesis(cfg.path(cfg.base.genesis_file))
+    provider = light_provider_from_config(ss_cfg, gen)
+    height = args.height or ss_cfg.trust_height
+    state = provider.state(height)
+    ddir = cfg.path(cfg.base.db_dir)
+    StateStore(open_db(cfg.base.db_backend, "state", ddir)).save(state)
+    BlockStore(open_db(cfg.base.db_backend, "blockstore", ddir)) \
+        .bootstrap_seen_commit(height, provider.commit(height))
+    print(f"bootstrapped state at height {height} "
+          f"(app_hash {state.app_hash.hex()[:16]})")
+    return 0
+
+
 def cmd_reset(args) -> int:
     """reference commands/reset.go unsafe-reset-all: wipe data, keep the
     privval key but reset its sign state carefully — we keep the state
@@ -346,6 +376,8 @@ def build_parser() -> argparse.ArgumentParser:
     tn.set_defaults(fn=cmd_testnet)
     rb = add("rollback", cmd_rollback)
     rb.add_argument("--hard", action="store_true")
+    bsst = add("bootstrap-state", cmd_bootstrap_state)
+    bsst.add_argument("--height", type=int, default=0)
     add("reset", cmd_reset)
     add("show-node-id", cmd_show_node_id)
     add("show-validator", cmd_show_validator)

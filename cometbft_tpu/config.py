@@ -61,7 +61,76 @@ class ConsensusTimeoutsConfig:
     timeout_precommit_delta: int = 500
     timeout_commit: int = 1000
     create_empty_blocks: bool = True
+    # advance the instant 100% of power precommitted (reference
+    # config.go SkipTimeoutCommit)
+    skip_timeout_commit: bool = True
     wal_file: str = "data/cs.wal"
+
+
+@dataclass
+class StateSyncConfig:
+    """reference config/config.go StateSyncConfig: bootstrap a fresh
+    node from an app snapshot + light-client trust anchor instead of
+    replaying history."""
+    enable: bool = False
+    rpc_servers: str = ""              # comma-separated host:port of
+    #                                    light-provider RPC endpoints
+    trust_height: int = 0
+    trust_hash: str = ""               # hex header hash at trust_height
+    trust_period_seconds: int = 168 * 3600   # reference default 168h
+    discovery_time_ms: int = 15_000
+    chunk_request_timeout_ms: int = 10_000
+
+    def validate_basic(self) -> None:
+        """reference config.go StateSyncConfig.ValidateBasic."""
+        if not self.enable:
+            return
+        if not self.rpc_servers or len(self.rpc_servers.split(",")) < 2:
+            # the reference requires >= 2 (config.go ValidateBasic):
+            # the second server witnesses the light-client cross-check;
+            # with only a primary a lying provider goes undetected
+            raise ValueError("statesync requires at least two rpc_servers")
+        if self.trust_height <= 0:
+            raise ValueError("statesync requires trust_height > 0")
+        if not self.trust_hash:
+            raise ValueError("statesync requires trust_hash")
+        bytes.fromhex(self.trust_hash)  # raises on malformed hex
+        if self.trust_period_seconds <= 0:
+            raise ValueError("statesync trust_period must be positive")
+        if self.chunk_request_timeout_ms < 1000:
+            raise ValueError("chunk_request_timeout must be >= 1s")
+
+
+@dataclass
+class BlockSyncConfig:
+    """reference config/config.go BlockSyncConfig."""
+    version: str = "v0"
+
+    def validate_basic(self) -> None:
+        if self.version != "v0":
+            raise ValueError(f"unknown blocksync version {self.version}")
+
+
+@dataclass
+class StorageConfig:
+    """reference config/config.go StorageConfig."""
+    discard_abci_responses: bool = False   # drop FinalizeBlock responses
+    #                                        (disables /block_results)
+    pruning_interval_ms: int = 10_000      # background pruner cadence
+
+    def validate_basic(self) -> None:
+        if self.pruning_interval_ms <= 0:
+            raise ValueError("pruning_interval must be positive")
+
+
+@dataclass
+class TxIndexConfig:
+    """reference config/config.go TxIndexConfig."""
+    indexer: str = "kv"                    # "kv" | "null"
+
+    def validate_basic(self) -> None:
+        if self.indexer not in ("kv", "null"):
+            raise ValueError(f"unknown indexer {self.indexer!r}")
 
 
 @dataclass
@@ -77,8 +146,12 @@ class Config:
     p2p: P2PConfig = dc_field(default_factory=P2PConfig)
     rpc: RPCConfig = dc_field(default_factory=RPCConfig)
     mempool: MempoolConfig = dc_field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = dc_field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = dc_field(default_factory=BlockSyncConfig)
     consensus: ConsensusTimeoutsConfig = dc_field(
         default_factory=ConsensusTimeoutsConfig)
+    storage: StorageConfig = dc_field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = dc_field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = dc_field(
         default_factory=InstrumentationConfig)
     root_dir: str = "."
@@ -92,6 +165,10 @@ class Config:
                      "timeout_precommit", "timeout_commit"):
             if getattr(self.consensus, name) < 0:
                 raise ValueError(f"negative {name}")
+        self.statesync.validate_basic()
+        self.blocksync.validate_basic()
+        self.storage.validate_basic()
+        self.tx_index.validate_basic()
 
     def path(self, rel: str) -> str:
         return os.path.join(self.root_dir, rel)
@@ -116,7 +193,11 @@ class Config:
         return "\n\n".join([
             emit("base", self.base), emit("p2p", self.p2p),
             emit("rpc", self.rpc), emit("mempool", self.mempool),
+            emit("statesync", self.statesync),
+            emit("blocksync", self.blocksync),
             emit("consensus", self.consensus),
+            emit("storage", self.storage),
+            emit("tx_index", self.tx_index),
             emit("instrumentation", self.instrumentation)]) + "\n"
 
     @classmethod
@@ -127,7 +208,11 @@ class Config:
         for section, target in (("base", cfg.base), ("p2p", cfg.p2p),
                                 ("rpc", cfg.rpc),
                                 ("mempool", cfg.mempool),
+                                ("statesync", cfg.statesync),
+                                ("blocksync", cfg.blocksync),
                                 ("consensus", cfg.consensus),
+                                ("storage", cfg.storage),
+                                ("tx_index", cfg.tx_index),
                                 ("instrumentation", cfg.instrumentation)):
             for k, v in d.get(section, {}).items():
                 if hasattr(target, k):

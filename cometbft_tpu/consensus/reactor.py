@@ -13,6 +13,7 @@ Wire: u8 kind || body. kinds: 1 proposal, 2 block part, 3 vote.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import List
 
@@ -30,6 +31,65 @@ VOTE_CHANNEL = 0x22
 _PROPOSAL = 1
 _BLOCK_PART = 2
 _VOTE = 3
+_ROUND_STATE = 4
+
+
+class RoundStateMessage:
+    """Periodic peer-state summary (the reference's NewRoundStep +
+    HasVote bitmaps compressed into one message,
+    internal/consensus/reactor.go:570-686): height/round/step plus
+    who-has-what bitmaps, so a peer can push exactly what this node is
+    missing. Heals dropped broadcasts — without it, gossip here is
+    broadcast-once and a lost vote/part has no retransmit path until
+    some later event fires."""
+
+    __slots__ = ("height", "round", "step", "has_proposal", "parts",
+                 "prevotes", "precommits")
+
+    def __init__(self, height, round_, step, has_proposal, parts,
+                 prevotes, precommits):
+        self.height = height
+        self.round = round_
+        self.step = step
+        self.has_proposal = has_proposal
+        self.parts = parts            # (total, mask int) or None
+        self.prevotes = prevotes      # (bits, mask int) or None
+        self.precommits = precommits  # (bits, mask int) or None
+
+    @staticmethod
+    def _f_bits(tag, pair):
+        if pair is None:
+            return b""
+        bits, mask = pair
+        return proto.f_varint(tag, bits) + proto.f_bytes(
+            tag + 1, mask.to_bytes((bits + 7) // 8 or 1, "little"))
+
+    def encode(self) -> bytes:
+        return (proto.f_varint(1, self.height)
+                + proto.f_varint(2, self.round)
+                + proto.f_varint(3, self.step)
+                + proto.f_varint(4, 1 if self.has_proposal else 0)
+                + self._f_bits(5, self.parts)
+                + self._f_bits(7, self.prevotes)
+                + self._f_bits(9, self.precommits))
+
+    @staticmethod
+    def _p_bits(f, tag):
+        bits = proto.field_int(f, tag, -1)
+        if bits < 0:
+            return None
+        raw = proto.field_bytes(f, tag + 1, b"\x00")
+        return bits, int.from_bytes(raw, "little")
+
+    @classmethod
+    def decode(cls, body: bytes) -> "RoundStateMessage":
+        f = proto.parse_fields(body)
+        return cls(proto.to_int64(proto.field_int(f, 1, 0)),
+                   proto.to_int64(proto.field_int(f, 2, 0)),
+                   proto.field_int(f, 3, 0),
+                   bool(proto.field_int(f, 4, 0)),
+                   cls._p_bits(f, 5), cls._p_bits(f, 7),
+                   cls._p_bits(f, 9))
 
 
 def encode_consensus_msg(msg: Message) -> tuple[int, bytes]:
@@ -82,14 +142,24 @@ def votes_from_commit(commit: Commit) -> List[Vote]:
 class ConsensusReactor:
     """p2p.Reactor wrapping a ConsensusState."""
 
+    # catch-up token bucket: burst covers a laggard finalizing a few
+    # consecutive heights; the refill rate bounds a hostile sweep
+    CATCHUP_BURST = 4
+    CATCHUP_REFILL_SECS = 2.0
+
     def __init__(self, cs: ConsensusState):
         self.cs = cs
         self._switch = None
         cs.broadcast = self._broadcast
-        # (peer_id, height) -> monotonic time of last catch-up help;
+        # peer.id -> (tokens, last_refill): catch-up token bucket;
         # keeps a stuck peer's once-per-round nil votes from triggering
         # a full commit+parts resend each time
         self._catchup_sent: dict = {}
+        # peer.id -> last same-height reconciliation served (see
+        # _on_round_state's budget)
+        self._reconcile_served: dict = {}
+        self._reconcile_thread = None
+        self._reconcile_stop = threading.Event()
         # (peer_id, height) -> count of precommits seen at height-1
         self._precommit_strikes: dict = {}
 
@@ -121,10 +191,136 @@ class ConsensusReactor:
         pass
 
     def receive(self, channel_id: int, peer, raw: bytes) -> None:
+        if raw and raw[0] == _ROUND_STATE:
+            self._on_round_state(RoundStateMessage.decode(raw[1:]), peer)
+            return
         msg = decode_consensus_msg(raw)
         if isinstance(msg, VoteMessage):
             self._maybe_catchup_peer(msg.vote, peer)
         self.cs.send(msg, peer_id=peer.id)
+
+    # --- periodic peer-state reconciliation ------------------------------
+
+    RECONCILE_SECS = 0.5
+
+    def start_reconciler(self) -> None:
+        """Broadcast our round state every RECONCILE_SECS so peers can
+        push exactly what we're missing (and vice versa) — the periodic
+        analog of the reference's three per-peer gossip goroutines
+        (reactor.go:209-211). Idempotent; reads consensus state without
+        taking ownership (GIL-atomic snapshots of ints/refs, vote-set
+        lookups with create=False so nothing mutates cross-thread)."""
+        if self._reconcile_thread is not None:
+            return
+        self._reconcile_stop = threading.Event()
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, name="cs-reconcile", daemon=True)
+        self._reconcile_thread.start()
+
+    def stop(self) -> None:
+        if self._reconcile_thread is not None:
+            self._reconcile_stop.set()
+            self._reconcile_thread = None
+
+    def _reconcile_loop(self) -> None:
+        while not self._reconcile_stop.wait(self.RECONCILE_SECS):
+            if self._switch is None:
+                continue
+            try:
+                msg = self._snapshot_round_state()
+            except Exception:  # noqa: BLE001 — racing a height change
+                continue
+            self._switch.broadcast(
+                VOTE_CHANNEL, bytes([_ROUND_STATE]) + msg.encode())
+
+    @staticmethod
+    def _peek_bits(votes, round_, type_):
+        if votes is None:
+            return None
+        vs = votes._get(round_, type_, create=False)
+        if vs is None:
+            return None
+        ba = vs.bit_array()
+        mask = 0
+        for i, w in enumerate(ba.to_words()):
+            mask |= w << (64 * i)
+        return ba.bits, mask
+
+    def _snapshot_round_state(self) -> RoundStateMessage:
+        rs = self.cs.rs
+        h, r, step = rs.height, rs.round, rs.step
+        parts = None
+        psets = rs.proposal_block_parts
+        if psets is not None:
+            mask = 0
+            for i, p in enumerate(psets.parts):
+                if p is not None:
+                    mask |= 1 << i
+            parts = (psets.header.total, mask)
+        from ..types.vote import PREVOTE_TYPE as PV, PRECOMMIT_TYPE as PC
+        return RoundStateMessage(
+            h, r, step, rs.proposal is not None, parts,
+            self._peek_bits(rs.votes, r, PV),
+            self._peek_bits(rs.votes, r, PC))
+
+    def _on_round_state(self, st: RoundStateMessage, peer) -> None:
+        """Push the peer exactly what its summary says it lacks."""
+        cs = self.cs
+        rs = cs.rs
+        if st.height < rs.height:
+            # lagging peer: serve the decided height (budgeted)
+            self._serve_decided_height(peer, st.height)
+            return
+        if st.height != rs.height or rs.votes is None:
+            return
+        # same-height serving is ALSO unauthenticated and can total a
+        # full proposal + parts + vote set per message — budget it to
+        # the honest reconcile cadence, or a hostile peer looping
+        # ~30-byte summaries becomes a bandwidth amplifier (the same
+        # attacker model as _serve_decided_height's token bucket)
+        now = time.monotonic()
+        if now - self._reconcile_served.get(peer.id, 0.0) < \
+                self.RECONCILE_SECS * 0.8:
+            return
+        if len(self._reconcile_served) > 4096:
+            cutoff = now - 60.0
+            self._reconcile_served = {
+                k: t for k, t in self._reconcile_served.items()
+                if t > cutoff}
+        self._reconcile_served[peer.id] = now
+        from ..types.vote import PREVOTE_TYPE as PV, PRECOMMIT_TYPE as PC
+        for type_, theirs in ((PV, st.prevotes), (PC, st.precommits)):
+            vs = rs.votes._get(st.round, type_, create=False)
+            if vs is None:
+                continue
+            their_mask = theirs[1] if theirs else 0
+            for vote in vs.list_votes():
+                if not (their_mask >> vote.validator_index) & 1:
+                    ch, raw = encode_consensus_msg(VoteMessage(vote))
+                    peer.try_send(ch, raw)
+        if rs.round > st.round:
+            # help the peer catch up rounds (reference gossipVotes
+            # serves higher-round votes): our current round's votes
+            for type_ in (PV, PC):
+                vs = rs.votes._get(rs.round, type_, create=False)
+                if vs is None:
+                    continue
+                for vote in vs.list_votes():
+                    ch, raw = encode_consensus_msg(VoteMessage(vote))
+                    peer.try_send(ch, raw)
+        if st.round == rs.round and rs.proposal is not None:
+            if not st.has_proposal:
+                ch, raw = encode_consensus_msg(
+                    ProposalMessage(rs.proposal))
+                peer.try_send(ch, raw)
+            psets = rs.proposal_block_parts
+            if psets is not None:
+                their_mask = st.parts[1] if st.parts else 0
+                for i, part in enumerate(psets.parts):
+                    if part is not None and not (their_mask >> i) & 1:
+                        ch, raw = encode_consensus_msg(
+                            BlockPartMessage(rs.height, rs.round, part))
+                        peer.try_send(ch, raw)
 
     def _maybe_catchup_peer(self, vote: Vote, peer) -> None:
         """A vote for a height below ours means the peer is lagging: feed
@@ -164,17 +360,39 @@ class ConsensusReactor:
             self._precommit_strikes[key] = strikes
             if strikes < 3:
                 return
+        self._serve_decided_height(peer, h)
+
+    def _serve_decided_height(self, peer, h: int) -> None:
+        """Stream commit votes + block parts for a decided height to a
+        lagging peer, under the per-peer token-bucket budget."""
+        cs = self.cs
+        store = cs.block_store
+        if store is None or h >= cs.rs.height:
+            return
         if not (store.base() <= h <= store.height()):
             return
         now = time.monotonic()
-        key = (peer.id, h)
-        if now - self._catchup_sent.get(key, 0.0) < 2.0:
+        # the budget is a per-PEER token bucket, not per (peer, height):
+        # the triggering vote is unauthenticated, and a per-height limit
+        # would let one peer sweep base()..height()-2 with ~100-byte
+        # fabricated prevotes and stream a different full block per
+        # message — a bandwidth amplifier bounded only by send_rate. A
+        # genuine laggard a few heights behind rides the burst (it needs
+        # consecutive heights quickly as it finalizes each); a sweeper
+        # drains the bucket and is held to one block per refill period.
+        # Deep catch-up is blocksync's job, not this path's.
+        tokens, last = self._catchup_sent.get(peer.id,
+                                              (self.CATCHUP_BURST, now))
+        tokens = min(self.CATCHUP_BURST,
+                     tokens + (now - last) / self.CATCHUP_REFILL_SECS)
+        if tokens < 1.0:
             return
         if len(self._catchup_sent) > 4096:
             cutoff = now - 60.0
-            self._catchup_sent = {k: t for k, t in
-                                  self._catchup_sent.items() if t > cutoff}
-        self._catchup_sent[key] = now
+            self._catchup_sent = {k: v for k, v in
+                                  self._catchup_sent.items()
+                                  if v[1] > cutoff}
+        self._catchup_sent[peer.id] = (tokens - 1.0, now)
         commit = store.load_seen_commit(h) or store.load_block_commit(h)
         if commit is None:
             return

@@ -59,6 +59,11 @@ class ConsensusConfig:
     timeout_precommit_delta: int = 500
     timeout_commit: int = 1000
     create_empty_blocks: bool = True
+    # start the next height the instant 100% of power has precommitted
+    # (reference config.go SkipTimeoutCommit / state.go:2405-2412):
+    # with every precommit in hand there is nothing left to gather and
+    # the commit timeout is a pure per-block latency floor
+    skip_timeout_commit: bool = True
 
     def propose(self, round_: int) -> int:
         return self.timeout_propose + self.timeout_propose_delta * round_
@@ -99,6 +104,10 @@ class RoundState:
     proposal: Optional[Proposal] = None
     proposal_block: Optional[Block] = None
     proposal_block_parts: Optional[PartSet] = None
+    # local wall clock when rs.proposal was accepted — what PBTS judges
+    # the proposal timestamp against (reference round_state.go:42
+    # ProposalReceiveTime, state.go:2069)
+    proposal_receive_time: Optional[Timestamp] = None
     locked_round: int = -1
     locked_block: Optional[Block] = None
     locked_block_parts: Optional[PartSet] = None
@@ -338,6 +347,7 @@ class ConsensusState:
             rs.proposal = None
             rs.proposal_block = None
             rs.proposal_block_parts = None
+            rs.proposal_receive_time = None
         rs.triggered_timeout_precommit = False
         rs.votes.set_round(round_ + 1)
         self._enter_propose(height, round_)
@@ -371,9 +381,12 @@ class ConsensusState:
                 self._priv_pubkey.address())
             parts = block.make_part_set()
         block_id = BlockID(block.hash(), parts.header)
+        # the proposal carries the BLOCK's timestamp (reference
+        # state.go:1243): under PBTS validators check the two are equal
+        # and judge the block time by the proposal's arrival
         proposal = Proposal(height=height, round=round_,
                             pol_round=rs.valid_round, block_id=block_id,
-                            timestamp=Timestamp.now())
+                            timestamp=block.header.time)
         try:
             self.priv_validator.sign_proposal(self.chain_id, proposal)
         except DoubleSignError:
@@ -393,6 +406,12 @@ class ConsensusState:
         if self.rs.last_commit is not None and \
                 self.rs.last_commit.has_two_thirds_majority():
             return self.rs.last_commit.make_commit()
+        if self.block_store is not None:
+            # restarted or statesynced proposer: the decided commit
+            # lives in the store, not in-memory votes (reference
+            # state.go:1227 LoadCommit fallback in decideProposal)
+            return (self.block_store.load_seen_commit(height - 1)
+                    or self.block_store.load_block_commit(height - 1))
         return None
 
     def _is_proposal_complete(self) -> bool:
@@ -425,6 +444,11 @@ class ConsensusState:
         if not proposer.pub_key.verify_signature(sb, proposal.signature):
             return  # ErrInvalidProposalSignature
         rs.proposal = proposal
+        # receive time is re-stamped on WAL replay; that cannot flip our
+        # recorded prevote (privval CheckHRS refuses to re-sign), it
+        # only affects metrics (reference records ReceiveTime in msgInfo
+        # for byte-exact replay — state.go:883)
+        rs.proposal_receive_time = Timestamp.now()
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.new_from_header(
                 proposal.block_id.parts)
@@ -491,6 +515,19 @@ class ConsensusState:
         if rs.proposal_block is None:
             self._sign_add_vote(PREVOTE_TYPE, b"", None)
             return
+        if self.state.consensus_params.pbts_enabled(height) and \
+                rs.proposal is not None:
+            # PBTS (reference state.go:1388-1416): the proposal and
+            # block timestamps must agree, and a fresh (non-POL)
+            # proposal must have arrived within the synchrony bounds of
+            # its own timestamp — otherwise prevote nil
+            if rs.proposal.timestamp != rs.proposal_block.header.time:
+                self._sign_add_vote(PREVOTE_TYPE, b"", None)
+                return
+            if rs.proposal.pol_round == -1 and \
+                    not self._proposal_is_timely():
+                self._sign_add_vote(PREVOTE_TYPE, b"", None)
+                return
         try:
             self.executor.validate_block(self.state, rs.proposal_block)
             app_ok = self.executor.process_proposal(
@@ -502,6 +539,15 @@ class ConsensusState:
                                 rs.proposal_block_parts.header)
         else:
             self._sign_add_vote(PREVOTE_TYPE, b"", None)
+
+    def _proposal_is_timely(self) -> bool:
+        """reference state.go:1361-1365 proposalIsTimely."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_receive_time is None:
+            return False
+        prec, delay = self.state.consensus_params.synchrony_in_round(
+            rs.proposal.round)
+        return rs.proposal.is_timely(rs.proposal_receive_time, prec, delay)
 
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
         """reference state.go:1424-1448."""
@@ -752,6 +798,11 @@ class ConsensusState:
             if rs.step != STEP_NEW_HEIGHT or rs.last_commit is None:
                 return
             rs.last_commit.add_vote(vote)
+            if self.config.skip_timeout_commit and \
+                    rs.last_commit.has_all():
+                # the straggler precommits all arrived: nothing more to
+                # gather during timeout_commit (reference state.go:2371)
+                self._enter_new_round(rs.height, 0)
             return
         if vote.height != rs.height:
             return
@@ -842,10 +893,12 @@ class ConsensusState:
             self._enter_precommit(rs.height, vote.round)
             if not bid.is_nil():
                 self._enter_commit(rs.height, vote.round)
-                if precommits.has_all():
-                    # everyone signed: no need to wait (reference
-                    # skipTimeoutCommit)
-                    pass
+                if self.config.skip_timeout_commit and \
+                        precommits.has_all():
+                    # everyone signed: skip the commit timeout — after
+                    # _enter_commit finalized, rs is at the next height
+                    # in STEP_NEW_HEIGHT, so this starts round 0 now
+                    self._enter_new_round(self.rs.height, 0)
             else:
                 self._enter_precommit_wait(rs.height, vote.round)
         elif rs.round <= vote.round and precommits.has_two_thirds_any():

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 _LEAF = b"\x00"
 _INNER = b"\x01"
@@ -72,6 +72,41 @@ class Proof:
             return self.compute_root() == root
         except ValueError:
             return False
+
+
+@dataclass
+class AbsenceProof:
+    """Proof that no leaf exists between two ADJACENT tree positions:
+    inclusion proofs for the left neighbor and (unless the left neighbor
+    is the last leaf) the right neighbor, carried with their raw leaf
+    bytes so the verifier can check the neighbors bracket the missing
+    item under the application's leaf ordering.
+
+    The reference verifies absence through its ProofRuntime op set
+    (light/rpc/client.go:149,182 VerifyAbsence over iavl range proofs);
+    this is the same guarantee re-based on the RFC-6962 tree: adjacency
+    of indices in a sorted-leaf tree means nothing lies between."""
+    left: Proof
+    left_leaf: bytes
+    right: Optional[Proof]
+    right_leaf: Optional[bytes]
+
+    def verify_adjacent(self, root: bytes) -> bool:
+        """Structural check only: both neighbors are in the tree under
+        `root` and are index-adjacent (or left is the final leaf). The
+        caller must separately check the leaf CONTENTS bracket the
+        missing key — ordering is an application-level contract."""
+        if not self.left.verify(root, self.left_leaf):
+            return False
+        if self.right is None:
+            return self.right_leaf is None and \
+                self.left.index == self.left.total - 1
+        if self.right_leaf is None:
+            return False
+        if not self.right.verify(root, self.right_leaf):
+            return False
+        return (self.right.total == self.left.total
+                and self.right.index == self.left.index + 1)
 
 
 def _compute_from_aunts(index: int, total: int, lh: bytes,

@@ -10,12 +10,14 @@ Layered the way schnorrkel is:
 - Schnorr: sig = R(32) || s(32) with schnorrkel's high-bit marker on s;
   k = transcript challenge binding proto-name, context, message, A, R.
 
-Structure follows the published schnorrkel/merlin/STROBE specs; the
-transcript byte-level framing is implemented from spec and validated for
-self-consistency (sign/verify/batch round-trips, tamper rejection)
-in-tree. Cross-implementation test vectors require a schnorrkel build
-not present in this environment — pin them before interop with substrate
-chains (tests/test_curves.py documents the gap).
+Structure follows the published schnorrkel/merlin/STROBE specs.
+Cross-implementation vectors pinned in tests/test_curves.py:
+- the merlin crate's transcript equivalence vector (byte-exact through
+  Keccak-f[1600] → STROBE-128 → Merlin framing), and
+- schnorrkel's MiniSecretKey Ed25519-expansion → public key vector
+  (byte-exact ristretto255 encode + scalar mul + cofactor division),
+which together cover every primitive a signature touches; sign/verify/
+batch round-trips and tamper rejection are validated in-tree on top.
 """
 
 from __future__ import annotations
@@ -357,6 +359,22 @@ class Sr25519PrivKey:
             return cls(secrets.token_bytes(32), secrets.token_bytes(32))
         return cls(bytes(rng.randrange(256) for _ in range(32)),
                    bytes(rng.randrange(256) for _ in range(32)))
+
+    @classmethod
+    def from_mini_secret(cls, seed: bytes) -> "Sr25519PrivKey":
+        """schnorrkel MiniSecretKey ExpandMode::Ed25519 (the substrate
+        default): scalar = ed25519-clamp(sha512(seed)[:32]) divided by
+        the cofactor, nonce = sha512(seed)[32:]. Pinned against the
+        public wasm-crypto derivation vector in tests/test_curves.py."""
+        if len(seed) != 32:
+            raise ValueError("mini secret must be 32 bytes")
+        h = hashlib.sha512(seed).digest()
+        key = bytearray(h[:32])
+        key[0] &= 248
+        key[31] &= 63
+        key[31] |= 64
+        scalar = int.from_bytes(bytes(key), "little") >> 3
+        return cls(scalar.to_bytes(32, "little"), h[32:64])
 
     def _scalar(self) -> int:
         return int.from_bytes(self.key, "little") % ed.L

@@ -11,6 +11,8 @@ from .runner import Manifest
 
 VALIDATOR_CHOICES = [2, 3, 4, 5]
 TIMEOUT_COMMIT_CHOICES = [20, 50, 100, 250]
+DB_CHOICES = ["memdb", "filedb", "native"]
+INDEXER_CHOICES = ["kv", "kv", "null"]  # kv-weighted like the reference
 
 
 def generate_manifests(seed: int = 1, n: int = 4) -> List[Manifest]:
@@ -21,5 +23,8 @@ def generate_manifests(seed: int = 1, n: int = 4) -> List[Manifest]:
         out.append(Manifest(
             chain_id=f"gen-{seed}-{i}",
             validators=rng.choice(VALIDATOR_CHOICES),
-            timeout_commit_ms=rng.choice(TIMEOUT_COMMIT_CHOICES)))
+            timeout_commit_ms=rng.choice(TIMEOUT_COMMIT_CHOICES),
+            db_backend=rng.choice(DB_CHOICES),
+            tx_indexer=rng.choice(INDEXER_CHOICES),
+            discard_abci_responses=rng.random() < 0.25))
     return out

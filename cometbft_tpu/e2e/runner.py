@@ -35,6 +35,11 @@ class Manifest:
     chain_id: str = "e2e-net"
     validators: int = 4
     timeout_commit_ms: int = 50
+    # config-space knobs the generator randomizes (reference
+    # test/e2e/generator randomizes database/abci/indexer choices)
+    db_backend: str = "filedb"            # memdb | filedb | native
+    tx_indexer: str = "kv"                # kv | null
+    discard_abci_responses: bool = False
 
     @classmethod
     def from_toml(cls, text: str) -> "Manifest":
@@ -42,7 +47,11 @@ class Manifest:
         d = tomllib.loads(text).get("testnet", {})
         return cls(chain_id=d.get("chain_id", "e2e-net"),
                    validators=int(d.get("validators", 4)),
-                   timeout_commit_ms=int(d.get("timeout_commit_ms", 50)))
+                   timeout_commit_ms=int(d.get("timeout_commit_ms", 50)),
+                   db_backend=d.get("db_backend", "filedb"),
+                   tx_indexer=d.get("tx_indexer", "kv"),
+                   discard_abci_responses=bool(
+                       d.get("discard_abci_responses", False)))
 
 
 def _free_ports(n: int) -> List[int]:
@@ -112,6 +121,10 @@ class Testnet:
             cfg.consensus.timeout_propose_delta = 250
             cfg.consensus.timeout_prevote = max(250, tc * 5)
             cfg.consensus.timeout_precommit = max(250, tc * 5)
+            cfg.base.db_backend = self.manifest.db_backend
+            cfg.tx_index.indexer = self.manifest.tx_indexer
+            cfg.storage.discard_abci_responses = \
+                self.manifest.discard_abci_responses
             cfg.write()
 
     # --- lifecycle (runner/start.go) -----------------------------------------

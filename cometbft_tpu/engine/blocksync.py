@@ -76,9 +76,13 @@ class TiledCommitVerifier:
         from ..types.validation import BATCH_VERIFY_THRESHOLD
         if not pubs:
             out = np.zeros((0,), dtype=bool)
-        elif len(pubs) < BATCH_VERIFY_THRESHOLD:
-            # small tiles (boot catch-up over a few heights): the native
-            # single-sig path beats a device dispatch + cold compile
+        elif self.batch_size <= 0 or len(pubs) < BATCH_VERIFY_THRESHOLD:
+            # batch_size<=0 = no device: CPU-backend nodes must never
+            # jit the RLC kernel mid-sync (a multi-minute XLA:CPU
+            # compile per bucket, and batches >=256 crash the compiler
+            # outright — docs/PERF.md). Small tiles take this path too:
+            # the native single-sig verify beats a device dispatch +
+            # cold compile for boot catch-up over a few heights.
             from ..crypto.keys import Ed25519PubKey
             out = np.array([
                 len(p) == 32 and Ed25519PubKey(p).verify_signature(m, s)

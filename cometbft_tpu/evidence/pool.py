@@ -62,14 +62,17 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, state: State,
 def verify_light_client_attack(ev: LightClientAttackEvidence,
                                state: State, common_vals,
                                trusted_header,
-                               common_time=None) -> None:
+                               common_time=None,
+                               trusted_commit=None) -> None:
     """reference internal/evidence/verify.go:110-160
     VerifyLightClientAttack.
 
     common_vals: validator set at ev.common_height (the trust anchor);
     trusted_header: this node's header at the conflicting height (None
     if beyond our tip); common_time: the committed block time at
-    common_height when known. Raises EvidenceError."""
+    common_height when known; trusted_commit: this node's commit for
+    the conflicting height when known (classifies equivocation vs
+    amnesia for the byzantine-list check). Raises EvidenceError."""
     from ..types import validation
     ev.validate_basic()
     lb = ev.conflicting_block
@@ -129,6 +132,59 @@ def verify_light_client_attack(ev: LightClientAttackEvidence,
             raise EvidenceError(
                 f"byzantine validator {val.address.hex()[:12]} did not "
                 f"sign the conflicting block")
+    # ...and the list must be COMPLETE: evidence that omits (all) the
+    # punishable addresses would otherwise commit a LIGHT_CLIENT_ATTACK
+    # with nobody to punish (reference verify.go:217-255 ValidateABCI
+    # compares count/addresses/powers against the computed list)
+    expected = expected_byzantine_validators(ev, common_vals,
+                                             trusted_header,
+                                             trusted_commit)
+    if expected is not None:
+        want = sorted((v.address, v.voting_power) for v in expected)
+        got = sorted((v.address, v.voting_power)
+                     for v in ev.byzantine_validators)
+        if want != got:
+            raise EvidenceError(
+                f"byzantine validator list mismatch: evidence names "
+                f"{len(got)}, computed intersection has {len(want)}")
+
+
+def expected_byzantine_validators(ev: LightClientAttackEvidence,
+                                  common_vals, trusted_header,
+                                  trusted_commit):
+    """The attack's punishable set, by attack style (reference
+    types/evidence.go:250-293 GetByzantineValidators). None when the
+    style cannot be determined locally (no trusted header/commit)."""
+    sh = ev.conflicting_block.signed_header
+    if trusted_header is None:
+        return None
+    if ev.conflicting_header_is_invalid(trusted_header):
+        # lunatic: common-set members who voted for the invalid header
+        out = []
+        for cs in sh.commit.signatures:
+            if not cs.for_block():
+                continue
+            _i, val = common_vals.get_by_address(cs.validator_address)
+            if val is not None:
+                out.append(val)
+        return out
+    if trusted_commit is None:
+        return None
+    if trusted_commit.round == sh.commit.round:
+        # equivocation: conflicting-set members who signed both commits
+        # (valset hashes match, so signature indexing is aligned)
+        out = []
+        vs = ev.conflicting_block.validator_set
+        for i, sa in enumerate(sh.commit.signatures):
+            if not sa.for_block() or i >= len(trusted_commit.signatures):
+                continue
+            if not trusted_commit.signatures[i].for_block():
+                continue
+            _j, val = vs.get_by_address(sa.validator_address)
+            if val is not None:
+                out.append(val)
+        return out
+    return []  # amnesia: no validators punished (reference :295-300)
 
 
 class EvidencePool:
@@ -141,6 +197,12 @@ class EvidencePool:
         self._committed: set = set()
         self._seen: set = set()
         self._lock = threading.RLock()
+        self._on_new: List = []
+
+    def on_new_evidence(self, cb) -> None:
+        """Register an admission hook (the gossip reactor broadcasts
+        from it — reference pool.go evidence clist waker)."""
+        self._on_new.append(cb)
 
     # --- intake --------------------------------------------------------------
 
@@ -173,23 +235,46 @@ class EvidencePool:
             self._verify_one(ev, state, val_set)
             self._pending.append(ev)
             self._seen.add(key)
-            return ev
+        # hooks run OUTSIDE the lock: the gossip broadcast they trigger
+        # can block on peer queues and must not hold up intake
+        for cb in self._on_new:
+            cb(ev)
+        return ev
 
     def _verify_one(self, ev, state: State, val_set) -> None:
         if isinstance(ev, LightClientAttackEvidence):
             trusted = None
             common_time = None
+            trusted_commit = None
             if self.block_store is not None:
-                meta = self.block_store.load_block_meta(
-                    ev.conflicting_block.height)
+                h = ev.conflicting_block.height
+                meta = self.block_store.load_block_meta(h)
                 if meta is not None:
                     trusted = meta[1]
+                trusted_commit = (self.block_store.load_seen_commit(h)
+                                  or self.block_store.load_block_commit(h))
                 common_meta = self.block_store.load_block_meta(
                     ev.common_height)
                 if common_meta is not None:
                     common_time = common_meta[1].time
+            if common_time is None:
+                # truncated store (statesynced node): the pinning block
+                # is gone, so bound the timestamp instead — not in the
+                # future, not outside the max-age window — so one attack
+                # can only mint hashes within a closing window rather
+                # than without limit (the exact-match dedup pin below is
+                # unavailable without the common block)
+                now = state.last_block_time.seconds
+                if ev.timestamp.seconds > now:
+                    raise EvidenceError(
+                        "evidence timestamp is in the future")
+                if now - ev.timestamp.seconds > \
+                        state.consensus_params.evidence_max_age_seconds:
+                    raise EvidenceError(
+                        "evidence timestamp outside the max-age window")
             verify_light_client_attack(ev, state, val_set, trusted,
-                                       common_time=common_time)
+                                       common_time=common_time,
+                                       trusted_commit=trusted_commit)
         else:
             verify_duplicate_vote(ev, state, val_set)
 

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..crypto.merkle import Proof
+from ..crypto.merkle import AbsenceProof, Proof
 from ..rpc.client import RPCClient
 from ..rpc.codec import (commit_from_json, header_from_json,
                          proof_from_json, validator_set_from_json)
@@ -49,18 +49,61 @@ class VerifyingClient:
             return r
         value = bytes.fromhex(r.get("value", ""))
         height = int(r.get("height", 0))
-        proof = proof_from_json(r.get("proof"))
-        if not value:
-            return r  # absence is not proven by this app (no range proofs)
+        try:
+            proof = proof_from_json(r.get("proof"))
+        except (ValueError, KeyError, TypeError) as e:
+            raise VerificationFailed(f"malformed proof: {e}")
         if proof is None or height <= 0:
-            raise VerificationFailed("primary returned no proof")
+            # a proofless empty value is the key-hiding attack the
+            # reference rejects via VerifyAbsence (light/rpc/client.go:
+            # 149,182) — never pass it through as a normal OK result
+            raise VerificationFailed(
+                "primary returned no proof"
+                + (" (unverified absence)" if not value else ""))
         lb = self.light.verify_light_block_at_height(height + 1)
         from ..abci.kvstore import KVStoreApplication
+        if not value:
+            self._verify_absence(proof, lb.header.app_hash, data, height)
+            return r
+        if isinstance(proof, AbsenceProof):
+            raise VerificationFailed(
+                "primary sent an absence proof with a non-empty value")
         leaf = KVStoreApplication.kv_leaf(data, value)
         if not proof.verify(lb.header.app_hash, leaf):
             raise VerificationFailed(
                 f"query proof does not match app hash at {height + 1}")
         return r
+
+    @staticmethod
+    def _verify_absence(proof, app_hash: bytes, data: bytes,
+                        height: int) -> None:
+        """Check an AbsenceProof really brackets `data`: both neighbors
+        are adjacent leaves of the verified tree, the left one sorts
+        before the key (or is the index-0 height sentinel for the
+        proven height), the right one after it (or the left neighbor is
+        the final leaf). Reference analog: light/rpc/client.go:182
+        VerifyAbsence over the registered proof runtime."""
+        from ..abci.kvstore import KVStoreApplication
+        if not isinstance(proof, AbsenceProof):
+            raise VerificationFailed(
+                "empty value requires an absence proof")
+        if not proof.verify_adjacent(app_hash):
+            raise VerificationFailed(
+                "absence proof neighbors not adjacent in verified tree")
+        left_kv = KVStoreApplication.parse_kv_leaf(proof.left_leaf)
+        if proof.left.index == 0:
+            sentinel = b"\x00" + height.to_bytes(8, "big")
+            if proof.left_leaf != sentinel:
+                raise VerificationFailed(
+                    "absence proof left sentinel is not the height leaf")
+        elif left_kv is None or left_kv[0] >= data:
+            raise VerificationFailed(
+                "absence proof left neighbor does not sort before key")
+        if proof.right is not None:
+            right_kv = KVStoreApplication.parse_kv_leaf(proof.right_leaf)
+            if right_kv is None or right_kv[0] <= data:
+                raise VerificationFailed(
+                    "absence proof right neighbor does not sort after key")
 
     def block(self, height: Optional[int] = None) -> Dict:
         r = self.primary.block(height)

@@ -257,3 +257,26 @@ def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
                                            zip215=zip215))
         outs.append(out[:hi - lo] & ok_mask[:hi - lo])
     return np.concatenate(outs)
+
+
+def prewarm_verify_kernels(batch_size: int = 4096,
+                           msg_cap: int = 128) -> None:
+    """Compile the (batch, msg-cap) bucket's RLC fast path AND the
+    per-lane attribution fallback before live traffic, so neither cold
+    jit lands mid-blocksync (the device server does the same at start,
+    device/server.py:_warm; this is the in-process caller's analog).
+
+    The tampered lane corrupts a LOW byte of s: the signature stays
+    structurally valid, the RLC batch EQUATION fails, and the fallback
+    kernel genuinely compiles — corrupting R instead fails at
+    decompression, which the structural mask attributes WITHOUT the
+    fallback, leaving it cold until the first live failed batch."""
+    pub, sig, msg = _dummy()
+    bad = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
+    pub_a, sig_a, hb, hn, _ = prepare_batch([pub], [msg], [sig],
+                                            batch_size, msg_cap)
+    z = make_rlc_coefficients(batch_size)
+    verify_rlc_kernel(pub_a, sig_a, hb, hn, z)
+    pub_a, sig_a, hb, hn, _ = prepare_batch([pub], [msg], [bad],
+                                            batch_size, msg_cap)
+    verify_kernel(pub_a, sig_a, hb, hn, zip215=True)

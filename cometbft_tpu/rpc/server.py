@@ -36,7 +36,8 @@ class RPCEnvironment:
     def __init__(self, chain_id: str, block_store=None, state_store=None,
                  mempool=None, consensus=None, event_bus=None,
                  tx_indexer=None, block_indexer=None, app_query=None,
-                 genesis=None, switch=None, state_getter=None):
+                 genesis=None, switch=None, state_getter=None,
+                 evidence_pool=None):
         self.chain_id = chain_id
         self.block_store = block_store
         self.state_store = state_store
@@ -48,6 +49,7 @@ class RPCEnvironment:
         self.app_query = app_query
         self.genesis = genesis
         self.switch = switch
+        self.evidence_pool = evidence_pool
         self.state_getter = state_getter or (
             (lambda: consensus.state) if consensus else (lambda: None))
 
@@ -137,7 +139,10 @@ class Routes:
         the canonical commit when block h+1 is stored, else the seen
         commit — enough for a light client to reconstruct and verify."""
         h = self._height_or_latest(height)
-        hdr = self.env.block_store.load_block(h).header
+        # one meta key read — this is the light provider's hot path
+        # (one /commit per verified height); reassembling the block from
+        # its parts just to read the header would cost O(block size)
+        hdr = self.env.block_store.load_block_meta(h)[1]
         c = self.env.block_store.load_block_commit(h)
         canonical = c is not None
         if c is None:
@@ -150,8 +155,87 @@ class Routes:
 
     def header(self, height=None) -> dict:
         h = self._height_or_latest(height)
-        blk = self.env.block_store.load_block(h)
-        return {"header": _header_json(blk.header)}
+        hdr = self.env.block_store.load_block_meta(h)[1]
+        return {"header": _header_json(hdr)}
+
+    def block_results(self, height=None) -> dict:
+        """reference rpc/core/blocks.go BlockResults, served from the
+        retained FinalizeBlock responses (state/store.go)."""
+        h = self._height_or_latest(height)
+        raw = (self.env.state_store.load_finalize_block_response(h)
+               if self.env.state_store else None)
+        if raw is None:
+            raise RPCError(
+                -32603, f"no results for height {h} (pruned, or "
+                        f"[storage] discard_abci_responses is set)")
+        from ..abci.application import ResponseFinalizeBlock
+        resp = ResponseFinalizeBlock.decode(raw)
+        return {
+            "height": h,
+            "txs_results": [
+                {"code": r.code, "data": r.data.hex(), "log": r.log,
+                 "gas_wanted": r.gas_wanted, "gas_used": r.gas_used}
+                for r in resp.tx_results],
+            "validator_updates": [
+                {"pub_key_type": u.pub_key_type,
+                 "pub_key_bytes": u.pub_key_bytes.hex(),
+                 "power": u.power}
+                for u in resp.validator_updates],
+            "consensus_param_updates": resp.consensus_param_updates,
+            "app_hash": resp.app_hash.hex(),
+        }
+
+    def broadcast_evidence(self, evidence="") -> dict:
+        """reference rpc/core/evidence.go BroadcastEvidence: verify +
+        admit into the pool (whence the gossip reactor floods it)."""
+        if self.env.evidence_pool is None:
+            raise RPCError(-32603, "evidence pool not available")
+        from ..types.evidence import EvidenceError, decode_evidence
+        try:
+            ev = decode_evidence(bytes.fromhex(evidence))
+        except (ValueError, KeyError, IndexError) as e:
+            raise RPCError(-32602, f"malformed evidence: {e}")
+        try:
+            self.env.evidence_pool.add_evidence(
+                ev, self.env.state_getter())
+        except EvidenceError as e:
+            raise RPCError(-32603, f"evidence rejected: {e}")
+        return {"hash": ev.hash().hex().upper()}
+
+    def _dial(self, csv: str, persistent: bool) -> dict:
+        if self.env.switch is None:
+            raise RPCError(-32603, "p2p switch not available")
+        if not csv:
+            raise RPCError(-32602, "no addresses provided")
+        dialed = []
+        for addr in csv.split(","):
+            host, _, port = addr.strip().rpartition(":")
+            try:
+                if persistent:
+                    self.env.switch.add_persistent_peer(host, int(port))
+                else:
+                    self.env.switch.dial(host, int(port))
+                dialed.append(addr.strip())
+            except (OSError, ValueError):
+                continue  # reference logs and moves on
+        return {"log": f"dialed {len(dialed)} addresses"}
+
+    def dial_seeds(self, seeds="") -> dict:
+        """reference rpc/core/net.go UnsafeDialSeeds (one-shot dials)."""
+        return self._dial(seeds, persistent=False)
+
+    def dial_peers(self, peers="", persistent=False) -> dict:
+        """reference rpc/core/net.go UnsafeDialPeers."""
+        if isinstance(persistent, str):
+            persistent = persistent.lower() in ("1", "true", "yes")
+        return self._dial(peers, persistent=persistent)
+
+    def unsafe_flush_mempool(self) -> dict:
+        """reference rpc/core/mempool.go UnsafeFlushMempool."""
+        if self.env.mempool is None:
+            raise RPCError(-32603, "mempool not available")
+        self.env.mempool.flush()
+        return {}
 
     def validators(self, height=None) -> dict:
         h = self._height_or_latest(height)
@@ -421,7 +505,9 @@ class RPCServer:
                     "broadcast_tx_async", "broadcast_tx_commit",
                     "check_tx", "unconfirmed_txs",
                     "num_unconfirmed_txs", "tx", "tx_search",
-                    "block_search", "wait_event")}
+                    "block_search", "wait_event", "block_results",
+                    "broadcast_evidence", "dial_seeds", "dial_peers",
+                    "unsafe_flush_mempool")}
 
         class Handler(BaseHTTPRequestHandler):
             # RFC 6455 requires the 101 on HTTP/1.1 (clients reject a

@@ -58,6 +58,25 @@ def validate_block(state: State, block: Block,
     if h.last_results_hash != state.last_results_hash:
         raise BlockValidationError("wrong last_results_hash")
 
+    # block time rules (reference state/validation.go:115-147): strictly
+    # increasing after the first block; first block at/after genesis
+    # time. The reference's pre-PBTS BFT-time equality check
+    # (block.Time == LastCommit.MedianTime) is intentionally NOT
+    # enforced: this chain's commit timestamps are advisory below the
+    # PBTS activation height (make_block still STAMPS the median there
+    # for parity), and under PBTS the prevote timeliness gate is the
+    # normative check (consensus/state.py _do_prevote).
+    t_ns = h.time.seconds * 1_000_000_000 + h.time.nanos
+    last_ns = (state.last_block_time.seconds * 1_000_000_000
+               + state.last_block_time.nanos)
+    if h.height == state.initial_height:
+        if t_ns < last_ns:
+            raise BlockValidationError(
+                "first block time precedes genesis time")
+    elif t_ns <= last_ns:
+        raise BlockValidationError(
+            "block time not greater than last block time")
+
     if h.height == state.initial_height:
         if block.last_commit.signatures:
             raise BlockValidationError(
@@ -101,6 +120,7 @@ class BlockExecutor:
         self.mempool = mempool
         self.evidence_pool = evidence_pool
         self.event_bus = event_bus
+        self.pruner = None  # background prune service (node-wired)
 
     # --- proposal path ------------------------------------------------------
 
@@ -185,13 +205,19 @@ class BlockExecutor:
         if self.mempool is not None:
             self.mempool.lock()
         try:
-            self.app.commit()
+            rc = self.app.commit()
             if self.mempool is not None:
                 self.mempool.update(block.header.height, block.data.txs,
                                     resp.tx_results)
         finally:
             if self.mempool is not None:
                 self.mempool.unlock()
+        if self.pruner is not None and rc is not None and \
+                getattr(rc, "retain_height", 0) > 0:
+            # honor the app's retain height (reference execution.go:315
+            # → pruner service); pruning runs in the background service,
+            # never on the commit path
+            self.pruner.set_retain_height(rc.retain_height)
 
         if self.evidence_pool is not None:
             self.evidence_pool.update(new_state, list(block.evidence))

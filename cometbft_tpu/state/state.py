@@ -30,10 +30,28 @@ class ConsensusParams:
     # ABCI vote extensions activate at this height; 0 = disabled
     # (reference types/params.go ABCIParams.VoteExtensionsEnableHeight)
     vote_extensions_enable_height: int = 0
+    # PBTS synchrony bounds (reference types/params.go:119-121 Synchrony
+    # Params, defaults :193-198): a proposal's timestamp is accepted iff
+    # receive_time ∈ [ts - precision, ts + message_delay + precision]
+    synchrony_precision_ns: int = 500_000_000         # 500ms
+    synchrony_message_delay_ns: int = 2_000_000_000   # 2s
 
     def extensions_enabled(self, height: int) -> bool:
         return (self.vote_extensions_enable_height > 0
                 and height >= self.vote_extensions_enable_height)
+
+    def pbts_enabled(self, height: int) -> bool:
+        """reference types/params.go:82 FeatureParams.PbtsEnabled."""
+        return (self.pbts_enable_height > 0
+                and height >= self.pbts_enable_height)
+
+    def synchrony_in_round(self, round_: int) -> tuple:
+        """(precision_ns, message_delay_ns) with message_delay grown 10%
+        per round (reference types/params.go:124-139 InRound) so a
+        network slower than the configured bound still eventually
+        accepts a correct proposer's timestamp."""
+        return (self.synchrony_precision_ns,
+                int((1.1 ** round_) * self.synchrony_message_delay_ns))
 
     def hash(self) -> bytes:
         """Wire-normative digest: sha256 over proto(HashedParams) which
@@ -111,8 +129,30 @@ class State:
         """reference state/state.go:233-263."""
         from ..types.evidence import EvidenceList
         if timestamp is None:
-            timestamp = (self.last_block_time if height == self.initial_height
-                         else Timestamp.now())
+            if height == self.initial_height:
+                # first block carries the genesis time
+                # (reference state/validation.go:139-145)
+                timestamp = self.last_block_time
+            else:
+                if self.consensus_params.pbts_enabled(height):
+                    # PBTS: the proposer stamps its own canonical clock;
+                    # validators judge it against receive time
+                    # (reference internal/consensus/state.go:1243 +
+                    # types/proposal.go:85-103)
+                    timestamp = Timestamp.now()
+                else:
+                    # BFT time: weighted median of the last commit
+                    # (reference types/block.go:922 MedianTime)
+                    timestamp = (last_commit.median_time(
+                        self.last_validators) or Timestamp.now())
+                # block time is strictly increasing
+                # (reference state/validation.go:122)
+                floor = (self.last_block_time.seconds * 1_000_000_000
+                         + self.last_block_time.nanos + 1)
+                have = timestamp.seconds * 1_000_000_000 + timestamp.nanos
+                if have < floor:
+                    timestamp = Timestamp(floor // 1_000_000_000,
+                                          floor % 1_000_000_000)
         data = Data(txs=list(txs))
         evidence = list(evidence or [])
         header = Header(
@@ -142,8 +182,12 @@ class StateStore:
 
     _KEY_STATE = b"statestore:state"
 
-    def __init__(self, db):
+    def __init__(self, db, retain_abci_responses: bool = True):
         self._db = db
+        # [storage] discard_abci_responses (reference config/config.go
+        # StorageConfig): dropping them reclaims space but disables the
+        # /block_results RPC for those heights
+        self._retain_abci = retain_abci_responses
 
     def save(self, state: State) -> None:
         self._db.set(self._KEY_STATE, _state_to_json(state))
@@ -161,6 +205,8 @@ class StateStore:
 
     def save_finalize_block_response(self, height: int, resp_bytes: bytes
                                      ) -> None:
+        if not self._retain_abci:
+            return
         self._db.set(b"abci:" + height.to_bytes(8, "big"), resp_bytes)
 
     def load_finalize_block_response(self, height: int) -> Optional[bytes]:
@@ -240,6 +286,10 @@ def _state_to_json(s: State) -> bytes:
             "pbts_enable_height": s.consensus_params.pbts_enable_height,
             "vote_extensions_enable_height":
                 s.consensus_params.vote_extensions_enable_height,
+            "synchrony_precision_ns":
+                s.consensus_params.synchrony_precision_ns,
+            "synchrony_message_delay_ns":
+                s.consensus_params.synchrony_message_delay_ns,
         },
     }).encode()
 

@@ -16,6 +16,31 @@ from ..state.state import ConsensusParams, GenesisDoc, State
 from ..types.block import BlockID
 
 
+def light_provider_from_config(ss_cfg, genesis: GenesisDoc
+                               ) -> "LightStateProvider":
+    """Build the light-client-backed provider from a [statesync] config
+    section (shared by node boot and the offline bootstrap-state CLI):
+    first rpc_server = primary, the rest = witnesses for the detector
+    cross-check."""
+    from ..db.kv import MemDB
+    from ..light.client import TrustOptions
+    from ..light.provider import HTTPProvider
+    from ..light.store import LightStore
+    from ..rpc.client import RPCClient
+    providers = []
+    for server in ss_cfg.rpc_servers.split(","):
+        host, _, port = server.strip().rpartition(":")
+        providers.append(HTTPProvider(genesis.chain_id,
+                                      RPCClient(host, int(port))))
+    lc = LightClient(
+        genesis.chain_id,
+        TrustOptions(period_seconds=ss_cfg.trust_period_seconds,
+                     height=ss_cfg.trust_height,
+                     hash=bytes.fromhex(ss_cfg.trust_hash)),
+        providers[0], providers[1:], LightStore(MemDB()))
+    return LightStateProvider(lc, genesis)
+
+
 class LightStateProvider:
     def __init__(self, light_client: LightClient, genesis: GenesisDoc):
         self.lc = light_client

@@ -133,6 +133,14 @@ class BlockStore:
         raw = self._db.get(_h(b"SC:", height))
         return Commit.decode(raw) if raw is not None else None
 
+    def bootstrap_seen_commit(self, height: int, commit: Commit) -> None:
+        """Statesync bootstrap (reference node/node.go:152
+        BootstrapState → store.SaveSeenCommit): record the
+        light-verified commit for the restored height so consensus can
+        propose at height+1 before any block exists locally."""
+        with self._lock:
+            self._db.set(_h(b"SC:", height), commit.encode())
+
     def delete_block(self, height: int) -> None:
         """Remove the TIP block (reference store/store.go
         DeleteLatestBlock — the rollback repair path)."""

@@ -159,6 +159,34 @@ class Commit:
         return merkle.hash_from_byte_slices(
             [cs.encode() for cs in self.signatures])
 
+    def median_time(self, val_set) -> Optional[Timestamp]:
+        """Voting-power-weighted median of the commit timestamps — BFT
+        time (reference types/block.go:922-950 MedianTime): with <1/3
+        byzantine power the median always lies between two honest
+        clocks. None when no counted signature carries a real timestamp
+        (synthetic commits); callers fall back to local time."""
+        stamped = []
+        total = 0
+        for cs in self.signatures:
+            if cs.absent_() or cs.timestamp.is_zero():
+                continue
+            _i, val = val_set.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            ns = cs.timestamp.seconds * 1_000_000_000 + cs.timestamp.nanos
+            stamped.append((ns, val.voting_power))
+            total += val.voting_power
+        if not stamped:
+            return None
+        stamped.sort()
+        acc, half = 0, total // 2
+        for ns, power in stamped:
+            acc += power
+            if acc > half:
+                return Timestamp(ns // 1_000_000_000, ns % 1_000_000_000)
+        return Timestamp(stamped[-1][0] // 1_000_000_000,
+                         stamped[-1][0] % 1_000_000_000)
+
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """Sign-bytes of the precommit this CommitSig attests
         (types/block.go:873-885 -> vote.go:150 -> canonical.go:57)."""

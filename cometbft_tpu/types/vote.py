@@ -155,3 +155,13 @@ class Proposal:
             raise ValueError("invalid POL round")
         if not self.block_id.is_complete():
             raise ValueError("proposal must have a complete blockID")
+
+    def is_timely(self, recv_time: Timestamp, precision_ns: int,
+                  message_delay_ns: int) -> bool:
+        """PBTS timeliness (reference types/proposal.go:85-103
+        IsTimely): accept iff
+          recv_time >= timestamp - precision, and
+          recv_time <= timestamp + message_delay + precision."""
+        ts = self.timestamp.seconds * 1_000_000_000 + self.timestamp.nanos
+        rt = recv_time.seconds * 1_000_000_000 + recv_time.nanos
+        return ts - precision_ns <= rt <= ts + message_delay_ns + precision_ns

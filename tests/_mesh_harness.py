@@ -1,0 +1,97 @@
+"""Fresh-interpreter harness for mesh/shard_map checks.
+
+Multi-device XLA:CPU executables segfault when built in a process that
+has already compiled many single-device kernels (reproduced at
+tests/test_parallel.py in rounds 2-3), so every mesh test runs here, in
+a subprocess, exactly like the driver's own `__graft_entry__.py dryrun`
+pattern. Not collected by pytest (no test_ prefix); invoked by
+tests/test_parallel.py.
+
+Usage: python tests/_mesh_harness.py {tally|graft}
+Prints "OK <which>" and exits 0 on success.
+"""
+
+import os
+import sys
+
+
+def _force_cpu_mesh(n=8):
+    # The ambient env pins JAX_PLATFORMS to the real-TPU tunnel and env
+    # vars are latched before we run, so the override must go through
+    # jax.config BEFORE any device access (see tests/conftest.py).
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from cometbft_tpu.libs.jax_cache import enable_compile_cache
+    enable_compile_cache()
+    return jax
+
+
+def _batch(n, msg_len=40, seed=3):
+    import random
+    from cometbft_tpu.crypto import ref_ed25519 as ref
+    rng = random.Random(seed)
+    pubs, msgs, sigs = [], [], []
+    for _ in range(n):
+        sd = bytes([rng.randrange(256) for _ in range(32)])
+        m = bytes([rng.randrange(256) for _ in range(msg_len)])
+        pubs.append(ref.pubkey_from_seed(sd))
+        msgs.append(m)
+        sigs.append(ref.sign(sd, m))
+    return pubs, msgs, sigs
+
+
+def run_tally():
+    """Sharded (commit, sig) grid verify with per-commit power tally,
+    including per-lane failure attribution (two corrupted signatures)."""
+    jax = _force_cpu_mesh(8)
+    import numpy as np
+    from cometbft_tpu.ops.ed25519 import prepare_batch
+    from cometbft_tpu.parallel.mesh import make_mesh
+    from cometbft_tpu.parallel.verify import make_sharded_verifier
+
+    assert len(jax.devices()) == 8
+    mesh = make_mesh(8)  # (4 commit-parallel, 2 sig-parallel)
+    C, V = 4, 4
+    pubs, msgs, sigs = _batch(C * V)
+    # corrupt one signature in commit 1 and one in commit 3
+    sigs[1 * V + 2] = bytes(64)
+    sigs[3 * V + 0] = sigs[3 * V + 0][:63] + bytes([sigs[3 * V + 0][63] ^ 1])
+    pub, sig, hb, hn, _ = prepare_batch(pubs, msgs, sigs, C * V, 64)
+    grid = lambda x: x.reshape(C, V, *x.shape[1:])
+    power = np.arange(1, C * V + 1, dtype=np.float32).reshape(C, V)
+
+    run = make_sharded_verifier(mesh)
+    ok, tally = run(grid(pub), grid(sig), grid(hb), grid(hn), power)
+    ok, tally = np.asarray(ok), np.asarray(tally)
+
+    want_ok = np.ones((C, V), dtype=bool)
+    want_ok[1, 2] = False
+    want_ok[3, 0] = False
+    assert (ok == want_ok).all()
+    want_tally = np.where(want_ok, power, 0).sum(axis=1)
+    assert (tally == want_tally).all()
+
+
+def run_graft():
+    """entry() compiles+verifies on one device, then the full multichip
+    dryrun — in THIS process order (single-device jit first, then the
+    8-device mesh), the exact sequence that used to segfault in-suite."""
+    jax = _force_cpu_mesh(8)
+    import numpy as np
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    out = np.asarray(jax.jit(fn)(*args))
+    assert out[:8].all()          # the 8 real signatures
+    g.dryrun_multichip(8)
+
+
+def main(which):
+    {"tally": run_tally, "graft": run_graft}[which]()
+    print("OK", which)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

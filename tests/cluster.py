@@ -86,8 +86,11 @@ class Cluster:
 
     def __init__(self, n_vals: int, config: ConsensusConfig = FAST_CONFIG,
                  chain_id: str = "tpu-cluster", wal_factory=None,
-                 drop: Optional[Callable[[int, int, object], bool]] = None):
+                 drop: Optional[Callable[[int, int, object], bool]] = None,
+                 params: Optional[Dict] = None):
         self.pvs, self.gen = make_genesis(n_vals, chain_id)
+        for k, v in (params or {}).items():
+            setattr(self.gen.consensus_params, k, v)
         self.nodes: List[Node] = []
         self.drop = drop or (lambda src, dst, msg: False)
         for i, pv in enumerate(self.pvs):

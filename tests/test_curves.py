@@ -2,12 +2,14 @@
 (reference crypto/secp256k1/secp256k1_test.go, crypto/sr25519/,
 crypto/batch — the BASELINE mixed-curve config).
 
-sr25519 NOTE: the implementation is structurally schnorrkel
-(merlin/STROBE transcripts over ristretto255) and fully self-consistent
-(sign/verify/batch round-trip, tamper rejection below), but
-cross-implementation byte-compat vectors are unpinnable in this
-environment (no schnorrkel build, no network). Pin vectors before
-substrate interop.
+sr25519 cross-implementation vectors (pinned below, VERDICT r3 weak #6):
+- the merlin crate's transcript equivalence vector — byte-exact through
+  our Keccak-f[1600] → STROBE-128 → Merlin stack;
+- schnorrkel's MiniSecretKey Ed25519-expansion public-key vector (the
+  seed "1234...12" pair from the public wasm-crypto test suite) —
+  byte-exact ristretto255 encode + scalar mul + cofactor division.
+Together these cover every primitive a signature touches; round-trips
+and tamper rejection validate the composition on top.
 """
 
 import random
@@ -110,6 +112,34 @@ def test_ristretto_roundtrip_and_canonicality():
     # non-canonical encodings rejected
     assert ristretto_decode(b"\xff" * 32) is None
     assert ristretto_decode((1).to_bytes(32, "little")) is None  # odd
+
+
+def test_merlin_transcript_cross_impl_vector():
+    """The merlin crate's equivalence test vector (merlin-rs
+    tests/transcript.rs): one fixed (protocol, message, challenge)
+    triple pins the whole Keccak→STROBE→Merlin stack byte-for-byte
+    against the Rust implementation schnorrkel uses."""
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    assert t.challenge_bytes(b"challenge", 32).hex() == \
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+
+
+def test_sr25519_mini_secret_cross_impl_vector():
+    """schnorrkel MiniSecretKey(ExpandMode::Ed25519) keypair vector from
+    the public @polkadot/wasm-crypto test suite: seed '12345678...' →
+    this exact public key. Pins sha512 expansion, ed25519 clamping,
+    cofactor division, scalar-mul, and ristretto255 encoding against
+    the Rust schnorrkel implementation."""
+    pv = Sr25519PrivKey.from_mini_secret(
+        b"12345678901234567890123456789012")
+    assert pv.pub_key().raw.hex() == \
+        "741c08a06f41c596608f6774259bd9043304adfa5d3eea62760bd9be97634d63"
+    # the derived pair signs/verifies through the normal path
+    msg = b"mini secret interop"
+    sig = pv.sign(msg)
+    assert pv.pub_key().verify_signature(msg, sig)
+    assert not pv.pub_key().verify_signature(msg + b"x", sig)
 
 
 def test_sr25519_sign_verify_roundtrip():

@@ -1,0 +1,111 @@
+"""Evidence gossip over the real p2p stack (reference
+internal/evidence/reactor.go:1-252): a double-sign witnessed by ONE
+node must reach every peer's pool via channel 0x38, get reaped by
+whichever node proposes next, committed in a block, and marked
+committed everywhere."""
+
+import time
+
+import pytest
+
+from test_node import _make_net
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+from cometbft_tpu.types.proto import Timestamp
+from cometbft_tpu.types.vote import Vote, PRECOMMIT_TYPE
+
+
+def _mesh(nodes):
+    nodes[0].start()
+    h0, p0 = nodes[0].p2p_addr
+    for nd in nodes[1:]:
+        nd.config.p2p.persistent_peers = f"{h0}:{p0}"
+        nd.start()
+    addrs = [nd.p2p_addr for nd in nodes]
+    for i, nd in enumerate(nodes):
+        for j, (h, p) in enumerate(addrs):
+            if j > i:
+                try:
+                    nd.switch.dial(h, p)
+                except OSError:
+                    pass
+
+
+def _craft_double_sign(nodes, height=1):
+    """Two conflicting precommits from one live validator at `height`,
+    signed with its real key (bypassing the privval guard the way a
+    malicious binary would — byzantine_test.go's trick)."""
+    byz_pv = nodes[0].priv_validator
+    state = nodes[0].consensus.state
+    vals = nodes[0].state_store.load_validators(height)
+    idx, val = vals.get_by_address(byz_pv.address())
+    assert val is not None
+
+    def vote(tag):
+        return Vote(type_=PRECOMMIT_TYPE, height=height, round=0,
+                    block_id=BlockID(tag * 32, PartSetHeader(1, tag * 32)),
+                    timestamp=Timestamp.now(),
+                    validator_address=byz_pv.address(),
+                    validator_index=idx)
+    a, b = vote(b"\xaa"), vote(b"\xbb")
+    chain_id = nodes[0].genesis.chain_id
+    for v in (a, b):
+        v.signature = byz_pv.priv_key.sign(v.sign_bytes(chain_id))
+    return DuplicateVoteEvidence.from_conflict(
+        a, b, vals, state.last_block_time)
+
+
+@pytest.mark.slow
+def test_evidence_gossips_and_commits(tmp_path):
+    nodes = _make_net(tmp_path)
+    try:
+        _mesh(nodes)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if all(nd.consensus.state.last_block_height >= 2
+                   for nd in nodes):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError("net never reached height 2")
+
+        ev = _craft_double_sign(nodes)
+        # ONLY node 0 witnesses it (direct pool injection — as if its
+        # own consensus loop raised the conflict)
+        admitted = nodes[0].evidence_pool.add_evidence(
+            ev, nodes[0].consensus.state)
+        assert admitted is not None
+
+        # every node's pool must learn it via gossip, and some proposer
+        # must commit it; then all nodes agree on the committing block
+        deadline = time.monotonic() + 180
+        committed_at = None
+        while time.monotonic() < deadline:
+            bs = nodes[0].block_store
+            for h in range(1, bs.height() + 1):
+                blk = bs.load_block(h)
+                if blk and blk.evidence:
+                    assert blk.evidence[0].hash() == ev.hash()
+                    committed_at = h
+            if committed_at:
+                break
+            time.sleep(0.1)
+        assert committed_at, "evidence never committed in a block"
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if all(nd.consensus.state.last_block_height >= committed_at
+                   for nd in nodes):
+                break
+            time.sleep(0.05)
+        for nd in nodes:
+            blk = nd.block_store.load_block(committed_at)
+            assert blk is not None and blk.evidence, \
+                f"{nd.config.base.moniker} missing evidence block"
+            assert blk.evidence[0].hash() == ev.hash()
+            # pool marked it committed: no longer pending anywhere
+            assert ev.hash() not in {e.hash() for e in
+                                     nd.evidence_pool.pending_evidence()}
+    finally:
+        for nd in nodes:
+            nd.stop()

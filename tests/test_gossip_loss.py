@@ -1,0 +1,98 @@
+"""Consensus under message loss (VERDICT r4 item 7): a 4-validator net
+whose transport drops 20% of consensus messages must still commit 20
+heights, healed by the periodic round-state reconciliation (the
+reference's NewRoundStep/HasVote per-peer gossip routines,
+internal/consensus/reactor.go:570-686; here
+consensus/reactor.py RoundStateMessage + _on_round_state).
+
+The fabric runs the REAL reactor wire path — encode_consensus_msg →
+lossy delivery → ConsensusReactor.receive — not the cluster harness's
+direct-inbox shortcut, so reconciliation itself is what keeps liveness.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from cluster import FAST_CONFIG, Node, make_genesis
+from cometbft_tpu.consensus.reactor import ConsensusReactor
+
+
+class LossyFabric:
+    """Full mesh delivering reactor bytes with seeded random drops.
+
+    A _Peer(owner, remote) is the handle node `owner` holds for node
+    `remote`: try_send delivers to `remote`'s reactor, handing it the
+    reverse handle so replies route back to the sender."""
+
+    def __init__(self, drop_rate: float, seed: int = 11):
+        self.drop_rate = drop_rate
+        self.rng = random.Random(seed)
+        self.reactors = []
+        self._lock = threading.Lock()
+
+    class _Peer:
+        def __init__(self, fabric, owner: int, remote: int):
+            self.fabric = fabric
+            self.owner, self.remote = owner, remote
+            self.id = f"node{remote}"
+
+        def try_send(self, ch, raw) -> bool:
+            with self.fabric._lock:
+                dropped = self.fabric.rng.random() < self.fabric.drop_rate
+            if not dropped:
+                back = LossyFabric._Peer(self.fabric, self.remote,
+                                         self.owner)
+                # deliver on the caller thread like a recv loop would
+                self.fabric.reactors[self.remote].receive(ch, back, raw)
+            return True
+
+    class _Switch:
+        def __init__(self, fabric, src: int):
+            self.fabric = fabric
+            self.src = src
+
+        def broadcast(self, ch, raw) -> None:
+            for dst in range(len(self.fabric.reactors)):
+                if dst != self.src:
+                    LossyFabric._Peer(self.fabric, self.src,
+                                      dst).try_send(ch, raw)
+
+    def wire(self, reactors) -> None:
+        self.reactors = reactors
+        for i, r in enumerate(reactors):
+            r.attach(self._Switch(self, i))
+
+
+@pytest.mark.slow
+def test_commits_20_heights_with_20pct_loss():
+    pvs, gen = make_genesis(4, chain_id="lossy-net")
+    nodes = [Node(gen, pv, FAST_CONFIG, name=f"n{i}")
+             for i, pv in enumerate(pvs)]
+    reactors = [ConsensusReactor(n.cs) for n in nodes]
+    fabric = LossyFabric(drop_rate=0.20)
+    fabric.wire(reactors)
+    try:
+        for r in reactors:
+            r.start_reconciler()
+        for n in nodes:
+            n.cs.start()
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if all(n.cs.state.last_block_height >= 20 for n in nodes):
+                break
+            time.sleep(0.1)
+        heights = [n.cs.state.last_block_height for n in nodes]
+        assert all(h >= 20 for h in heights), \
+            f"stalled under loss: heights={heights}"
+        # no forks
+        for h in range(1, 21):
+            hashes = {n.block_store.load_block(h).hash() for n in nodes}
+            assert len(hashes) == 1, f"fork at {h}"
+    finally:
+        for r in reactors:
+            r.stop()
+        for n in nodes:
+            n.cs.stop()

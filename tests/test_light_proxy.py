@@ -93,32 +93,81 @@ def test_verifying_client_proves_query(net):
     vc.validators(tip)
 
 
-def test_verifying_client_rejects_lying_primary(net):
+def test_verifying_client_proves_absence(net):
+    """An absent key must come back with a VERIFIED absence proof —
+    the reference rejects proofless absence via VerifyAbsence
+    (light/rpc/client.go:149,182)."""
     c, rpc0, rpc1 = net
+    vc = VerifyingClient(_light_client(c, rpc0, rpc1), rpc0)
+    r = vc.abci_query("/store", b"nosuchkey")
+    assert r["value"] == ""
+    assert "absence" in r["proof"], "absence must ride a tagged proof"
 
-    class LyingApp:
-        """Honest proofs, dishonest value."""
 
-        def __getattr__(self, name):
-            return getattr(c.nodes[0].app, name)
-
-        def query_prove(self, path, data):
-            code, value, height, pf = c.nodes[0].app.query_prove(
-                path, data)
-            return code, b"42", height, pf  # forged value
-
-    srv = RPCServer(RPCEnvironment(
+def _lying_server(c, app):
+    return RPCServer(RPCEnvironment(
         chain_id="light-proxy-chain",
         block_store=c.nodes[0].block_store,
         state_store=c.nodes[0].state_store,
-        app_query=LyingApp(),
+        app_query=app,
         state_getter=lambda: c.nodes[0].cs.state))
+
+
+class _Liar:
+    def __init__(self, node):
+        self._node = node
+
+    def __getattr__(self, name):
+        return getattr(self._node.app, name)
+
+
+def test_verifying_client_rejects_lying_primary(net):
+    c, rpc0, rpc1 = net
+
+    class LyingApp(_Liar):
+        """Honest proofs, dishonest value."""
+
+        def query_prove(self, path, data):
+            code, value, height, pf = self._node.app.query_prove(
+                path, data)
+            return code, b"42", height, pf  # forged value
+
+    srv = _lying_server(c, LyingApp(c.nodes[0]))
     srv.start()
     try:
         liar = RPCClient("127.0.0.1", srv.addr[1])
         vc = VerifyingClient(_light_client(c, rpc0, rpc1), liar)
         with pytest.raises(VerificationFailed):
             vc.abci_query("/store", b"alpha")
+    finally:
+        srv.stop()
+
+
+def test_verifying_client_rejects_hidden_key(net):
+    """The key-hiding attack: a lying primary answers a PRESENT key
+    with value="" — proofless, or dressed in the key's own inclusion
+    proof. Both must fail verification (ADVICE r3 medium)."""
+    c, rpc0, rpc1 = net
+
+    class HidingApp(_Liar):
+        dress = False
+
+        def query_prove(self, path, data):
+            code, value, height, pf = self._node.app.query_prove(
+                path, data)
+            return code, b"", height, (pf if self.dress else None)
+
+    app = HidingApp(c.nodes[0])
+    srv = _lying_server(c, app)
+    srv.start()
+    try:
+        liar = RPCClient("127.0.0.1", srv.addr[1])
+        vc = VerifyingClient(_light_client(c, rpc0, rpc1), liar)
+        with pytest.raises(VerificationFailed):
+            vc.abci_query("/store", b"alpha")      # proofless hide
+        app.dress = True
+        with pytest.raises(VerificationFailed):
+            vc.abci_query("/store", b"alpha")      # inclusion-proof hide
     finally:
         srv.stop()
 
@@ -138,9 +187,9 @@ def test_light_proxy_serves_verified_routes(net):
         assert blk["block"]["header"]["height"] == tip
         vals = client.call("validators", height=tip)
         assert len(vals["validators"]) == 4
-        # absent keys come back unproven-but-empty, not an error
+        # absent keys come back empty WITH a verified absence proof
         r = client.call("abci_query", path="/store",
                         data=b"nosuchkey".hex())
-        assert r["value"] == ""
+        assert r["value"] == "" and "absence" in r["proof"]
     finally:
         proxy.stop()

@@ -17,7 +17,7 @@ from cometbft_tpu.state.state import GenesisDoc
 from cometbft_tpu.types.validator import Validator
 
 
-def _make_net(tmp_path, n=4):
+def _make_net(tmp_path, n=4, timeout_commit=50, skip_timeout_commit=True):
     import random
     rng = random.Random(17)
     pvs = [FilePV.generate(str(tmp_path / f"pv{i}.json"), rng)
@@ -26,7 +26,9 @@ def _make_net(tmp_path, n=4):
         pv._save()
     vals = [Validator(pv.get_pub_key(), 10) for pv in pvs]
     order = sorted(range(n), key=lambda i: vals[i].address)
+    from cometbft_tpu.types.proto import Timestamp
     gen = GenesisDoc(chain_id="node-net",
+                     genesis_time=Timestamp.now(),
                      validators=[vals[i] for i in order])
     nodes = []
     for rank, i in enumerate(order):
@@ -39,7 +41,9 @@ def _make_net(tmp_path, n=4):
             timeout_propose=500, timeout_propose_delta=250,
             timeout_prevote=250, timeout_prevote_delta=150,
             timeout_precommit=250, timeout_precommit_delta=150,
-            timeout_commit=50, wal_file="data/cs.wal")
+            timeout_commit=timeout_commit,
+            skip_timeout_commit=skip_timeout_commit,
+            wal_file="data/cs.wal")
         save_genesis(gen, str(root / "config/genesis.json"))
         nodes.append(Node(cfg, KVStoreApplication(), genesis=gen,
                           priv_validator=pvs[i]))
@@ -51,11 +55,39 @@ def test_config_toml_roundtrip(tmp_path):
     cfg.base.chain_id = "toml-chain"
     cfg.consensus.timeout_propose = 1234
     cfg.mempool.size = 99
+    cfg.statesync.enable = True
+    cfg.statesync.rpc_servers = "127.0.0.1:1,127.0.0.1:2"
+    cfg.statesync.trust_height = 7
+    cfg.statesync.trust_hash = "ab" * 32
+    cfg.storage.discard_abci_responses = True
+    cfg.tx_index.indexer = "null"
     path = cfg.write()
     loaded = Config.load(str(tmp_path))
     assert loaded.base.chain_id == "toml-chain"
     assert loaded.consensus.timeout_propose == 1234
     assert loaded.mempool.size == 99
+    assert loaded.statesync.enable and loaded.statesync.trust_height == 7
+    assert loaded.statesync.trust_hash == "ab" * 32
+    assert loaded.statesync.rpc_servers.count(",") == 1
+    assert loaded.storage.discard_abci_responses is True
+    assert loaded.tx_index.indexer == "null"
+    assert loaded.blocksync.version == "v0"
+
+
+def test_config_validation_rejects_bad_sections(tmp_path):
+    import pytest as _pytest
+    cfg = Config(root_dir=str(tmp_path))
+    cfg.statesync.enable = True  # no rpc_servers / trust anchor
+    with _pytest.raises(ValueError):
+        cfg.validate_basic()
+    cfg = Config(root_dir=str(tmp_path))
+    cfg.tx_index.indexer = "elastic"
+    with _pytest.raises(ValueError):
+        cfg.validate_basic()
+    cfg = Config(root_dir=str(tmp_path))
+    cfg.blocksync.version = "v9"
+    with _pytest.raises(ValueError):
+        cfg.validate_basic()
 
 
 def test_genesis_file_roundtrip(tmp_path):
@@ -155,6 +187,28 @@ def test_four_node_network_commits_and_serves_rpc(tmp_path):
         done = rpc1.call("broadcast_tx_commit",
                          tx=b"committed=yes".hex())
         assert done["tx_result"]["code"] == 0 and done["height"] > 0
+
+        # round-4 tail routes (reference rpc/core/routes.go parity)
+        br = rpc1.call("block_results", height=done["height"])
+        assert br["height"] == done["height"]
+        assert any(t["code"] == 0 for t in br["txs_results"])
+        assert br["app_hash"]
+        assert rpc1.call("unsafe_flush_mempool") == {}
+        assert "dialed" in rpc1.call(
+            "dial_peers",
+            peers=f"{addrs[3][0]}:{addrs[3][1]}")["log"]
+        assert "dialed" in rpc1.call(
+            "dial_seeds",
+            seeds=f"{addrs[3][0]}:{addrs[3][1]}")["log"]
+        from test_evidence_gossip import _craft_double_sign
+        ev = _craft_double_sign(nodes)
+        r = rpc1.call("broadcast_evidence",
+                      evidence=ev.encode().hex())
+        assert r["hash"] == ev.hash().hex().upper()
+        # rejected garbage gets a clean error, not a crash
+        from cometbft_tpu.rpc.client import RPCClientError
+        with pytest.raises(RPCClientError):
+            rpc1.call("broadcast_evidence", evidence="deadbeef")
     finally:
         for nd in nodes:
             nd.stop()

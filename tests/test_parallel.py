@@ -1,56 +1,34 @@
 """Sharded commit verification over the virtual 8-device CPU mesh
 (the in-process stand-in for a real TPU pod slice, mirroring how the
-reference tests multi-node behavior in-process — SURVEY §4)."""
+reference tests multi-node behavior in-process — SURVEY §4).
 
-import numpy as np
-import jax
+Each test runs in a FRESH interpreter (tests/_mesh_harness.py): building
+a multi-device XLA:CPU executable in a process that has already compiled
+many single-device kernels segfaults this jaxlib build (reproduced
+deterministically in rounds 2-3 at this file), so the suite isolates the
+mesh path the same way the driver's `__graft_entry__.py dryrun` does.
+"""
 
-from cometbft_tpu.crypto import ref_ed25519 as ref
-from cometbft_tpu.ops.ed25519 import prepare_batch
-from cometbft_tpu.parallel.mesh import make_mesh
-from cometbft_tpu.parallel.verify import make_sharded_verifier
+import os
+import subprocess
+import sys
+
+_HARNESS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_mesh_harness.py")
 
 
-def _batch(n, msg_len=40, seed=3):
-    import random
-    rng = random.Random(seed)
-    pubs, msgs, sigs = [], [], []
-    for _ in range(n):
-        sd = bytes([rng.randrange(256) for _ in range(32)])
-        m = bytes([rng.randrange(256) for _ in range(msg_len)])
-        pubs.append(ref.pubkey_from_seed(sd))
-        msgs.append(m)
-        sigs.append(ref.sign(sd, m))
-    return pubs, msgs, sigs
+def _run(which, timeout=900):
+    r = subprocess.run([sys.executable, _HARNESS, which],
+                       capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, (
+        f"mesh harness {which!r} rc={r.returncode}\n"
+        f"--- stdout ---\n{r.stdout}\n--- stderr ---\n{r.stderr[-4000:]}")
+    assert f"OK {which}" in r.stdout, r.stdout
 
 
 def test_sharded_commit_verify_with_tally():
-    assert len(jax.devices()) == 8
-    mesh = make_mesh(8)  # (4 commit-parallel, 2 sig-parallel)
-    C, V = 4, 4
-    pubs, msgs, sigs = _batch(C * V)
-    # corrupt one signature in commit 1 and one in commit 3
-    sigs[1 * V + 2] = bytes(64)
-    sigs[3 * V + 0] = sigs[3 * V + 0][:63] + bytes([sigs[3 * V + 0][63] ^ 1])
-    pub, sig, hb, hn, _ = prepare_batch(pubs, msgs, sigs, C * V, 64)
-    grid = lambda x: x.reshape(C, V, *x.shape[1:])
-    power = np.arange(1, C * V + 1, dtype=np.float32).reshape(C, V)
-
-    run = make_sharded_verifier(mesh)
-    ok, tally = run(grid(pub), grid(sig), grid(hb), grid(hn), power)
-    ok, tally = np.asarray(ok), np.asarray(tally)
-
-    want_ok = np.ones((C, V), dtype=bool)
-    want_ok[1, 2] = False
-    want_ok[3, 0] = False
-    assert (ok == want_ok).all()
-    want_tally = np.where(want_ok, power, 0).sum(axis=1)
-    assert (tally == want_tally).all()
+    _run("tally")
 
 
 def test_graft_entry():
-    import __graft_entry__ as g
-    fn, args = g.entry()
-    out = np.asarray(jax.jit(fn)(*args))
-    assert out[:8].all()          # the 8 real signatures
-    g.dryrun_multichip(8)
+    _run("graft")
