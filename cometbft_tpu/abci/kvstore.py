@@ -257,15 +257,15 @@ class KVStoreApplication(BaseApplication):
     def list_snapshots(self) -> List[Snapshot]:
         """The retained interval snapshots, blobs captured at commit
         time — chunks must stay byte-stable while later blocks commit,
-        or the restorer's hash check fails. A consensus-idle app (tests
-        driving apply_block by hand) that never crossed an interval
-        falls back to capturing its current committed state."""
+        or the restorer's hash check fails. An app that has not crossed
+        an interval yet serves nothing (the reference behaves the same
+        before its first interval); writing a fallback capture HERE
+        would mutate the dict from the snapshot-connection thread and
+        re-introduce the advertise-the-live-tip anchor race commit()
+        exists to avoid."""
         if self.last_height == 0:
             return []
         blobs = self._snapshot_blobs  # atomic ref: see commit()
-        if not blobs:
-            blobs = {self.last_height: self._snapshot_blob()}
-            self._snapshot_blobs = blobs
         out = []
         for h in sorted(blobs, reverse=True):
             blob = blobs[h]

@@ -170,11 +170,21 @@ def cmd_bootstrap_state(args) -> int:
     ss_cfg.enable = True  # reuse its validation for the trust anchor
     ss_cfg.validate_basic()
     gen = load_genesis(cfg.path(cfg.base.genesis_file))
+    ddir = cfg.path(cfg.base.db_dir)
+    store = StateStore(open_db(cfg.base.db_backend, "state", ddir))
+    existing = store.load()
+    if existing is not None and existing.last_block_height > 0:
+        # reference BootstrapState refuses a non-empty state store: the
+        # app and block store still hold the old height, and clobbering
+        # the state would desync all three with no error until start
+        print(f"refusing to bootstrap: state store already at height "
+              f"{existing.last_block_height} (run `reset` first if you "
+              f"really mean to discard it)", file=sys.stderr)
+        return 1
     provider = light_provider_from_config(ss_cfg, gen)
     height = args.height or ss_cfg.trust_height
     state = provider.state(height)
-    ddir = cfg.path(cfg.base.db_dir)
-    StateStore(open_db(cfg.base.db_backend, "state", ddir)).save(state)
+    store.save(state)
     BlockStore(open_db(cfg.base.db_backend, "blockstore", ddir)) \
         .bootstrap_seen_commit(height, provider.commit(height))
     print(f"bootstrapped state at height {height} "

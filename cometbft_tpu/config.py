@@ -40,6 +40,11 @@ class P2PConfig:
 class RPCConfig:
     laddr: str = "127.0.0.1:0"
     enable: bool = True
+    # expose dial_seeds/dial_peers/unsafe_flush_mempool (reference
+    # config.go RPCConfig.Unsafe — off by default: statesync requires
+    # operators to expose RPC publicly, and these routes let any caller
+    # flush the mempool or steer peering)
+    unsafe: bool = False
 
 
 @dataclass

@@ -234,6 +234,16 @@ class ConsensusReactor:
                 VOTE_CHANNEL, bytes([_ROUND_STATE]) + msg.encode())
 
     @staticmethod
+    def _sweep_stale(d: dict, now: float, stamp) -> None:
+        """Bound a peer-keyed limiter dict: evict entries idle >60s once
+        it grows past 4096 (shared by the catch-up token bucket and the
+        reconciliation budget — one policy, one sweep)."""
+        if len(d) > 4096:
+            cutoff = now - 60.0
+            for k in [k for k, v in d.items() if stamp(v) <= cutoff]:
+                del d[k]
+
+    @staticmethod
     def _peek_bits(votes, round_, type_):
         if votes is None:
             return None
@@ -282,11 +292,7 @@ class ConsensusReactor:
         if now - self._reconcile_served.get(peer.id, 0.0) < \
                 self.RECONCILE_SECS * 0.8:
             return
-        if len(self._reconcile_served) > 4096:
-            cutoff = now - 60.0
-            self._reconcile_served = {
-                k: t for k, t in self._reconcile_served.items()
-                if t > cutoff}
+        self._sweep_stale(self._reconcile_served, now, lambda t: t)
         self._reconcile_served[peer.id] = now
         from ..types.vote import PREVOTE_TYPE as PV, PRECOMMIT_TYPE as PC
         for type_, theirs in ((PV, st.prevotes), (PC, st.precommits)):
@@ -387,11 +393,7 @@ class ConsensusReactor:
                      tokens + (now - last) / self.CATCHUP_REFILL_SECS)
         if tokens < 1.0:
             return
-        if len(self._catchup_sent) > 4096:
-            cutoff = now - 60.0
-            self._catchup_sent = {k: v for k, v in
-                                  self._catchup_sent.items()
-                                  if v[1] > cutoff}
+        self._sweep_stale(self._catchup_sent, now, lambda v: v[1])
         self._catchup_sent[peer.id] = (tokens - 1.0, now)
         commit = store.load_seen_commit(h) or store.load_block_commit(h)
         if commit is None:

@@ -231,8 +231,10 @@ class LightClient:
                 # detector walks its trace the same way,
                 # detector.go examineConflictingHeaderAgainstTrace)
                 common = self._common_anchor(w, lb.height)
-                ev_witness = self._make_attack_evidence(other, common)
-                ev_primary = self._make_attack_evidence(lb, common)
+                ev_witness = self._make_attack_evidence(other, common,
+                                                        counterpart=lb)
+                ev_primary = self._make_attack_evidence(lb, common,
+                                                        counterpart=other)
                 self._report(self.primary, ev_witness)
                 self._report(w, ev_primary)
                 raise ConflictingHeadersError(lb, other, i,
@@ -267,20 +269,42 @@ class LightClient:
             except ProviderError:
                 pass
 
-    def _make_attack_evidence(self, conflicting: LightBlock, common):
+    def _make_attack_evidence(self, conflicting: LightBlock, common,
+                              counterpart: LightBlock = None):
         """Evidence anchored at the highest trusted height below the
-        conflict (the common header, detector.go:169)."""
+        conflict (the common header, detector.go:169).
+
+        The byzantine list MUST use the same per-attack-style formula
+        full nodes verify with (evidence/pool.py
+        expected_byzantine_validators — lunatic / equivocation /
+        amnesia, reference types/evidence.go:250-300): a list built
+        with the lunatic formula for a non-lunatic attack would fail
+        every pool's completeness check and the genuine evidence would
+        be dropped network-wide. `counterpart` is the block the honest
+        side holds at the same height (classifies the style)."""
+        from ..evidence.pool import expected_byzantine_validators
         from ..types.evidence import LightClientAttackEvidence
         if common is None:
             return None
-        signers = {cs.validator_address for cs in
-                   conflicting.signed_header.commit.signatures
-                   if cs.for_block()}
-        byzantine = [v for v in common.validator_set.validators
-                     if v.address in signers]
-        return LightClientAttackEvidence(
+        ev = LightClientAttackEvidence(
             conflicting_block=conflicting,
             common_height=common.height,
-            byzantine_validators=byzantine,
+            byzantine_validators=[],
             total_voting_power=common.validator_set.total_voting_power(),
             timestamp=common.header.time)
+        byz = expected_byzantine_validators(
+            ev, common.validator_set,
+            counterpart.header if counterpart is not None else None,
+            counterpart.signed_header.commit
+            if counterpart is not None else None)
+        if byz is None:
+            # style undeterminable (no counterpart): fall back to the
+            # lunatic formula — verifiers without the trusted block
+            # skip completeness too
+            signers = {cs.validator_address for cs in
+                       conflicting.signed_header.commit.signatures
+                       if cs.for_block()}
+            byz = [v for v in common.validator_set.validators
+                   if v.address in signers]
+        ev.byzantine_validators = byz
+        return ev

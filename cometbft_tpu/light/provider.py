@@ -52,6 +52,39 @@ class BlockStoreProvider:
         return LightBlock(SignedHeader(blk.header, commit), vals)
 
 
+def fetch_all_validators(rpc_client, height=None, max_pages=64):
+    """Merge the paginated /validators pages into one response dict.
+
+    Hardened for the light client's adversary model: later pages are
+    PINNED to page 1's block_height (unpinned 'latest' pages could
+    straddle a height change and merge two sets — a spurious hash
+    failure against an honest primary), an empty page stops the walk
+    (no progress), and max_pages bounds it (a byzantine primary
+    advertising total=10^9 must not hang the caller; 64 pages × 100 =
+    6400 validators, far above any real set). 'count' reflects the
+    merged list."""
+    merged = None
+    page = 1
+    while page <= max_pages:
+        kw = {"page": page, "per_page": 100}
+        if height is not None:
+            kw["height"] = height
+        r = rpc_client.call("validators", **kw)
+        if merged is None:
+            merged = r
+            height = r.get("block_height", height)  # pin later pages
+        else:
+            if not r.get("validators"):
+                break
+            merged["validators"].extend(r["validators"])
+        if len(merged["validators"]) >= r.get(
+                "total", len(merged["validators"])):
+            break
+        page += 1
+    merged["count"] = len(merged["validators"])
+    return merged
+
+
 class HTTPProvider:
     """Light blocks over a full node's JSON-RPC (reference
     light/provider/http/http.go): /commit gives the signed header,
@@ -74,8 +107,11 @@ class HTTPProvider:
             sh = SignedHeader(
                 header_from_json(c["signed_header"]["header"]),
                 commit_from_json(c["signed_header"]["commit"]))
+            # the route is paginated (reference http provider walks
+            # pages the same way); the FULL set is needed — a truncated
+            # one can never match the header's validators_hash
             vals = validator_set_from_json(
-                self._rpc.validators(sh.height))
+                fetch_all_validators(self._rpc, height=sh.height))
         except (RPCClientError, OSError, KeyError, ValueError) as e:
             raise ErrLightBlockNotFound(
                 f"height {height}: {e}") from e

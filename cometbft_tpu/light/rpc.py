@@ -155,8 +155,10 @@ class VerifyingClient:
         return r
 
     def validators(self, height: Optional[int] = None) -> Dict:
-        r = self.primary.call("validators", **(
-            {} if height is None else {"height": height}))
+        # page through (bounded, height-pinned): the hash check below
+        # needs the FULL set at ONE height
+        from .provider import fetch_all_validators
+        r = fetch_all_validators(self.primary, height=height)
         vals = validator_set_from_json(r)
         h = int(r.get("block_height", 0))
         if h <= 0:

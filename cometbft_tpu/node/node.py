@@ -205,7 +205,8 @@ class Node:
                 block_indexer=self.block_indexer,
                 app_query=self.app_conns.query, genesis=self.genesis,
                 switch=self.switch,
-                evidence_pool=self.evidence_pool), host, port)
+                evidence_pool=self.evidence_pool,
+                unsafe=config.rpc.unsafe), host, port)
 
     @staticmethod
     def _split_addr(addr: str):

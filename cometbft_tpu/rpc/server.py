@@ -37,7 +37,7 @@ class RPCEnvironment:
                  mempool=None, consensus=None, event_bus=None,
                  tx_indexer=None, block_indexer=None, app_query=None,
                  genesis=None, switch=None, state_getter=None,
-                 evidence_pool=None):
+                 evidence_pool=None, unsafe=False):
         self.chain_id = chain_id
         self.block_store = block_store
         self.state_store = state_store
@@ -50,6 +50,7 @@ class RPCEnvironment:
         self.genesis = genesis
         self.switch = switch
         self.evidence_pool = evidence_pool
+        self.unsafe = unsafe
         self.state_getter = state_getter or (
             (lambda: consensus.state) if consensus else (lambda: None))
 
@@ -237,13 +238,23 @@ class Routes:
         self.env.mempool.flush()
         return {}
 
-    def validators(self, height=None) -> dict:
+    def validators(self, height=None, page=1, per_page=30) -> dict:
+        """reference rpc/core/consensus.go Validators (paginated — a
+        200-validator set exceeds sane single responses)."""
         h = self._height_or_latest(height)
         vals = (self.env.state_store.load_validators(h)
                 if self.env.state_store else None)
         if vals is None:
             raise RPCError(-32603, f"no validator set at height {h}")
-        return {"block_height": h, **validator_set_json(vals)}
+        page = max(1, int(page))
+        per_page = min(max(1, int(per_page)), 100)
+        js = validator_set_json(vals)
+        total = len(js["validators"])
+        lo = (page - 1) * per_page
+        window = js["validators"][lo:lo + per_page]
+        return {"block_height": h, "validators": window,
+                "proposer": js["proposer"],
+                "count": len(window), "total": total}
 
     # --- ABCI ----------------------------------------------------------------
 
@@ -295,13 +306,33 @@ class Routes:
                 "total_bytes": self.env.mempool.size_bytes(),
                 "txs": [t.hex() for t in txs]}
 
-    def tx(self, hash="") -> dict:
+    def tx(self, hash="", prove=False) -> dict:
         got = self.env.tx_indexer.get(bytes.fromhex(hash))
         if got is None:
             raise RPCError(-32603, f"tx {hash} not found")
         height, index, raw, code = got
-        return {"hash": hash, "height": height, "index": index,
-                "tx": raw.hex(), "tx_result": {"code": code}}
+        out = {"hash": hash, "height": height, "index": index,
+               "tx": raw.hex(), "tx_result": {"code": code}}
+        if isinstance(prove, str):  # GET query-string form
+            prove = prove.lower() in ("1", "true", "yes")
+        if prove:
+            # inclusion proof against the block's data_hash (reference
+            # rpc/core/tx.go Tx w/ prove → types.Tx.Proof): data_hash =
+            # merkle over the tx list, so the proof binds the tx to the
+            # (light-verifiable) header
+            blk = self.env.block_store.load_block(height)
+            if blk is None:
+                raise RPCError(-32603, f"block {height} pruned")
+            from ..crypto.merkle import proofs_from_byte_slices
+            # Data.hash leaves are sha256(tx) (types/block.py:344), so
+            # the proof's leaf is the tx HASH; a verifier checks
+            # proof.verify(header.data_hash, sha256(raw_tx))
+            root, proofs = proofs_from_byte_slices(
+                [tx_hash(t) for t in blk.data.txs])
+            out["proof"] = {"root_hash": root.hex(),
+                            "data": raw.hex(),
+                            "proof": proof_json(proofs[index])}
+        return out
 
     def tx_search(self, query="", limit=None) -> dict:
         try:
@@ -494,20 +525,22 @@ class RPCServer:
         (the light proxy reuses this server with verified routes)."""
         if methods is None:
             routes = Routes(env)
-            methods = {
-                name: getattr(routes, name) for name in (
-                    "health", "status", "net_info", "genesis",
-                    "genesis_chunked", "block", "block_by_hash",
-                    "blockchain", "commit", "header", "header_by_hash",
-                    "validators", "consensus_state",
-                    "dump_consensus_state", "consensus_params",
-                    "abci_info", "abci_query", "broadcast_tx_sync",
-                    "broadcast_tx_async", "broadcast_tx_commit",
-                    "check_tx", "unconfirmed_txs",
-                    "num_unconfirmed_txs", "tx", "tx_search",
-                    "block_search", "wait_event", "block_results",
-                    "broadcast_evidence", "dial_seeds", "dial_peers",
-                    "unsafe_flush_mempool")}
+            names = ["health", "status", "net_info", "genesis",
+                     "genesis_chunked", "block", "block_by_hash",
+                     "blockchain", "commit", "header", "header_by_hash",
+                     "validators", "consensus_state",
+                     "dump_consensus_state", "consensus_params",
+                     "abci_info", "abci_query", "broadcast_tx_sync",
+                     "broadcast_tx_async", "broadcast_tx_commit",
+                     "check_tx", "unconfirmed_txs",
+                     "num_unconfirmed_txs", "tx", "tx_search",
+                     "block_search", "wait_event", "block_results",
+                     "broadcast_evidence"]
+            if env is not None and env.unsafe:
+                # reference routes.go:56-62: only with rpc.unsafe=true
+                names += ["dial_seeds", "dial_peers",
+                          "unsafe_flush_mempool"]
+            methods = {name: getattr(routes, name) for name in names}
 
         class Handler(BaseHTTPRequestHandler):
             # RFC 6455 requires the 101 on HTTP/1.1 (clients reject a

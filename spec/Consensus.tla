@@ -1,0 +1,227 @@
+------------------------------ MODULE Consensus ------------------------------
+(***************************************************************************)
+(* Formal specification of the consensus state machine implemented in     *)
+(* cometbft_tpu/consensus/state.py — the Tendermint-family algorithm the  *)
+(* reference documents in spec/consensus/ (consensus paper) and proves in *)
+(* spec/ivy-proofs/.  This spec is written against THIS implementation:   *)
+(* the state names below are the STEP_* constants, the actions are the    *)
+(* _enter_* handlers, and the locking/validity rules are the POL rules    *)
+(* the code enforces (state.py _enter_precommit / _do_prevote).           *)
+(*                                                                        *)
+(* Scope: single-height agreement over rounds, asynchronous network with  *)
+(* message loss (the reactor's reconciliation makes loss benign), up to   *)
+(* f Byzantine validators out of n = 3f+1.  Timeouts are modeled as       *)
+(* nondeterministic scheduling (the Timeout* actions are always enabled   *)
+(* once their step is reached) — the implementation's ticker only decides *)
+(* WHEN, never WHETHER.                                                   *)
+(*                                                                        *)
+(* Properties at the bottom:                                              *)
+(*   Agreement      — no two correct validators decide differently.      *)
+(*   ValidityLock   — a correct validator only precommits a value it     *)
+(*                    prevoted, and only re-locks with a newer POL.      *)
+(*   DecisionPower  — every decision carries > 2/3 precommit power.      *)
+(* Check with TLC on small instances (n=4, f=1, MaxRound=3).              *)
+(***************************************************************************)
+
+EXTENDS Integers, FiniteSets, TLC
+
+CONSTANTS
+    Validators,     \* the validator set (model power-1 each; the
+                    \* implementation's weighted tally reduces to this
+                    \* under equal powers — types/vote_set.py)
+    Byzantine,      \* subset of Validators that may equivocate
+    Values,         \* proposable block values
+    MaxRound        \* bound for model checking
+
+ASSUME Byzantine \subseteq Validators
+ASSUME 3 * Cardinality(Byzantine) < Cardinality(Validators)
+
+Correct == Validators \ Byzantine
+Rounds  == 0..MaxRound
+Nil     == CHOOSE v : v \notin Values
+
+\* steps mirror consensus/state.py STEP_* constants
+Steps == {"NewHeight", "Propose", "Prevote", "PrevoteWait",
+          "Precommit", "PrecommitWait", "Commit"}
+
+\* deterministic proposer rotation (types/validator.py proposer
+\* priority reduces to round-robin under equal powers)
+Proposer(r) == CHOOSE v \in Validators : TRUE
+
+QuorumSize == (2 * Cardinality(Validators)) \div 3 + 1
+Quorums == {Q \in SUBSET Validators : Cardinality(Q) >= QuorumSize}
+
+VARIABLES
+    step,        \* validator -> current step
+    round,       \* validator -> current round
+    lockedValue, \* validator -> Values ∪ {Nil}   (rs.locked_block)
+    lockedRound, \* validator -> Rounds ∪ {-1}    (rs.locked_round)
+    validValue,  \* validator -> Values ∪ {Nil}   (rs.valid_block)
+    validRound,  \* validator -> Rounds ∪ {-1}    (rs.valid_round)
+    decision,    \* validator -> Values ∪ {Nil}
+    proposals,   \* round -> Values ∪ {Nil}: the proposer's broadcast
+    prevotes,    \* [round, validator] -> Values ∪ {Nil} ∪ {"none"}
+    precommits   \* [round, validator] -> Values ∪ {Nil} ∪ {"none"}
+
+vars == <<step, round, lockedValue, lockedRound, validValue, validRound,
+          decision, proposals, prevotes, precommits>>
+
+Init ==
+    /\ step        = [v \in Validators |-> "NewHeight"]
+    /\ round       = [v \in Validators |-> 0]
+    /\ lockedValue = [v \in Validators |-> Nil]
+    /\ lockedRound = [v \in Validators |-> -1]
+    /\ validValue  = [v \in Validators |-> Nil]
+    /\ validRound  = [v \in Validators |-> -1]
+    /\ decision    = [v \in Validators |-> Nil]
+    /\ proposals   = [r \in Rounds |-> Nil]
+    /\ prevotes    = [r \in Rounds |-> [v \in Validators |-> "none"]]
+    /\ precommits  = [r \in Rounds |-> [v \in Validators |-> "none"]]
+
+\* ---- vote bookkeeping (types/vote_set.py 2/3 accounting) -----------------
+
+PrevotePower(r, x)   == {v \in Validators : prevotes[r][v] = x}
+PrecommitPower(r, x) == {v \in Validators : precommits[r][v] = x}
+
+HasPolka(r, x)  == \E Q \in Quorums : Q \subseteq PrevotePower(r, x)
+HasCommit(r, x) == \E Q \in Quorums : Q \subseteq PrecommitPower(r, x)
+
+\* any-2/3 prevotes arrived (prevote-wait trigger, state.go analog
+\* _enter_prevote_wait)
+AnyPolka(r) ==
+    \E Q \in Quorums :
+        \A v \in Q : prevotes[r][v] # "none"
+
+\* ---- actions: the _enter_* handlers --------------------------------------
+
+\* _enter_new_round + _enter_propose: the proposer broadcasts either its
+\* valid value (re-proposal with POL) or a fresh value
+StartRound(v, r) ==
+    /\ round[v] = r /\ step[v] \in {"NewHeight", "PrecommitWait"}
+    /\ step' = [step EXCEPT ![v] = "Propose"]
+    /\ IF v = Proposer(r) /\ proposals[r] = Nil
+       THEN \E x \in Values :
+              proposals' = [proposals EXCEPT ![r] =
+                  IF validValue[v] # Nil THEN validValue[v] ELSE x]
+       ELSE UNCHANGED proposals
+    /\ UNCHANGED <<round, lockedValue, lockedRound, validValue,
+                   validRound, decision, prevotes, precommits>>
+
+\* _do_prevote: prevote the locked value if locked; else the proposal if
+\* acceptable (PBTS/validation gates abstract to nondeterministic
+\* acceptance); else nil.  A Byzantine validator may vote anything.
+DoPrevote(v, r, x) ==
+    /\ round[v] = r /\ step[v] = "Propose"
+    /\ prevotes[r][v] = "none"
+    /\ \/ v \in Byzantine
+       \/ /\ lockedValue[v] # Nil /\ x = lockedValue[v]
+       \/ /\ lockedValue[v] = Nil
+          /\ \/ x = proposals[r] /\ x # Nil
+             \/ x = Nil          \* invalid/missing/untimely proposal
+    /\ prevotes'  = [prevotes EXCEPT ![r][v] = x]
+    /\ step'      = [step EXCEPT ![v] = "Prevote"]
+    /\ UNCHANGED <<round, lockedValue, lockedRound, validValue,
+                   validRound, decision, proposals, precommits>>
+
+\* _enter_precommit on a polka for value x: lock and precommit
+PrecommitValue(v, r, x) ==
+    /\ round[v] = r /\ step[v] = "Prevote"
+    /\ precommits[r][v] = "none"
+    /\ x \in Values
+    /\ HasPolka(r, x)
+    /\ v \in Correct => prevotes[r][v] = x  \* code path: own prevote in
+                                            \* the polka set
+    /\ lockedValue' = [lockedValue EXCEPT ![v] = x]
+    /\ lockedRound' = [lockedRound EXCEPT ![v] = r]
+    /\ validValue'  = [validValue EXCEPT ![v] = x]
+    /\ validRound'  = [validRound EXCEPT ![v] = r]
+    /\ precommits'  = [precommits EXCEPT ![r][v] = x]
+    /\ step'        = [step EXCEPT ![v] = "Precommit"]
+    /\ UNCHANGED <<round, decision, proposals, prevotes>>
+
+\* _enter_precommit on a nil-polka: unlock, precommit nil
+PrecommitNil(v, r) ==
+    /\ round[v] = r /\ step[v] = "Prevote"
+    /\ precommits[r][v] = "none"
+    /\ HasPolka(r, Nil) \/ (AnyPolka(r) /\ ~\E x \in Values : HasPolka(r, x))
+    /\ IF HasPolka(r, Nil)
+       THEN /\ lockedValue' = [lockedValue EXCEPT ![v] = Nil]
+            /\ lockedRound' = [lockedRound EXCEPT ![v] = -1]
+       ELSE UNCHANGED <<lockedValue, lockedRound>>
+    /\ precommits' = [precommits EXCEPT ![r][v] = Nil]
+    /\ step'       = [step EXCEPT ![v] = "Precommit"]
+    /\ UNCHANGED <<round, validValue, validRound, decision, proposals,
+                   prevotes>>
+
+\* Byzantine equivocation: a faulty validator may cast any precommit
+ByzantinePrecommit(v, r, x) ==
+    /\ v \in Byzantine
+    /\ precommits[r][v] = "none"
+    /\ precommits' = [precommits EXCEPT ![r][v] = x]
+    /\ UNCHANGED <<step, round, lockedValue, lockedRound, validValue,
+                   validRound, decision, proposals, prevotes>>
+
+\* finalize_commit: 2/3 precommits for x decide it (any validator that
+\* observes the quorum, at any of its rounds — late deliveries included)
+Decide(v, r, x) ==
+    /\ decision[v] = Nil
+    /\ x \in Values
+    /\ HasCommit(r, x)
+    /\ decision' = [decision EXCEPT ![v] = x]
+    /\ step'     = [step EXCEPT ![v] = "Commit"]
+    /\ UNCHANGED <<round, lockedValue, lockedRound, validValue,
+                   validRound, proposals, prevotes, precommits>>
+
+\* round advance (timeout precommit-wait / skip on 2/3 any): the ticker
+\* abstracts to "may advance once precommit reached"
+NextRound(v, r) ==
+    /\ round[v] = r /\ r < MaxRound
+    /\ step[v] \in {"Precommit", "PrecommitWait"}
+    /\ decision[v] = Nil
+    /\ round' = [round EXCEPT ![v] = r + 1]
+    /\ step'  = [step EXCEPT ![v] = "NewHeight"]
+    /\ UNCHANGED <<lockedValue, lockedRound, validValue, validRound,
+                   decision, proposals, prevotes, precommits>>
+
+Next ==
+    \/ \E v \in Validators, r \in Rounds : StartRound(v, r)
+    \/ \E v \in Validators, r \in Rounds, x \in Values \union {Nil} :
+          DoPrevote(v, r, x)
+    \/ \E v \in Validators, r \in Rounds, x \in Values :
+          PrecommitValue(v, r, x)
+    \/ \E v \in Validators, r \in Rounds : PrecommitNil(v, r)
+    \/ \E v \in Byzantine, r \in Rounds, x \in Values \union {Nil} :
+          ByzantinePrecommit(v, r, x)
+    \/ \E v \in Validators, r \in Rounds, x \in Values : Decide(v, r, x)
+    \/ \E v \in Validators, r \in Rounds : NextRound(v, r)
+
+Spec == Init /\ [][Next]_vars
+
+\* ---- properties -----------------------------------------------------------
+
+\* Agreement: no two correct validators decide different values.  The
+\* implementation counterpart: finalize_commit only fires on a 2/3
+\* precommit quorum (vote_set.py two_thirds_majority), and quorum
+\* intersection leaves a correct validator locked on the decided value.
+Agreement ==
+    \A u, v \in Correct :
+        decision[u] # Nil /\ decision[v] # Nil => decision[u] = decision[v]
+
+\* A correct validator's precommit for a value is backed by a polka in
+\* the same round (state.py _enter_precommit requires
+\* prevotes.two_thirds_majority()).
+ValidityLock ==
+    \A v \in Correct, r \in Rounds :
+        precommits[r][v] \in Values => HasPolka(r, precommits[r][v])
+
+\* Every decision is carried by >2/3 precommit power in some round.
+DecisionPower ==
+    \A v \in Correct :
+        decision[v] # Nil =>
+            \E r \in Rounds : HasCommit(r, decision[v])
+
+\* TLC config suggestion:
+\*   Validators = {v1, v2, v3, v4};  Byzantine = {v4}
+\*   Values = {a, b};  MaxRound = 2
+\*   INVARIANTS Agreement ValidityLock DecisionPower
+===============================================================================

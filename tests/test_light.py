@@ -258,3 +258,97 @@ def test_light_client_attack_evidence(chain):
     with pytest.raises(EvidenceError):
         verify_light_client_attack(weak_ev, state, common_vals,
                                    real.header)
+
+
+def test_two_witness_fork_at_common_height(chain):
+    """Two witnesses — one honest, one serving a consistently-signed
+    fork from a divergence height onward (reference
+    light/detector_test.go's fork-at-common-height case): the detector
+    must blame the RIGHT witness, anchor the evidence at a height both
+    chains share (below the divergence), cross-report — the witness's
+    fork to the primary, the primary's chain to the forked witness —
+    and leave the honest witness unaccused."""
+    from dataclasses import replace
+
+    from cometbft_tpu.engine.chain_gen import sign_commit
+    from cometbft_tpu.evidence.pool import verify_light_client_attack
+    from cometbft_tpu.state.state import State
+    from cometbft_tpu.types.block import BlockID
+    from cometbft_tpu.types.evidence import LightClientAttackEvidence
+
+    target = chain.max_height()
+    fork_from = target - 3
+
+    class ForkedWitness(ChainProvider):
+        """Forged app hashes from fork_from up, each height signed by
+        the same 2/5 byzantine subset (>= 1/3 of common power)."""
+
+        def __init__(self, chain, fork_from):
+            super().__init__(chain)
+            self.fork_from = fork_from
+            self.reported = []
+            self._cache = {}
+
+        def light_block(self, height):
+            if height == 0:
+                height = self.chain.max_height()
+            if height < self.fork_from:
+                return super().light_block(height)
+            if height not in self._cache:
+                real = self.chain.blocks[height - 1]
+                vals = self.chain.valsets[height - 1]
+                hdr = replace(real.header, app_hash=b"\x77" * 32)
+                forged = replace(real, header=hdr)
+                byz = vals.validators[:2]
+                keys = {v.address: self.chain.keys[v.address]
+                        for v in byz}
+                fid = BlockID(forged.hash(),
+                              forged.make_part_set().header)
+
+                class _Sub:
+                    validators = byz
+                commit = sign_commit(self.chain.chain_id, height, 0,
+                                     fid, _Sub, keys)
+                self._cache[height] = LightBlock(
+                    SignedHeader(hdr, commit), vals.copy())
+            return self._cache[height]
+
+        def report_evidence(self, ev):
+            self.reported.append(ev)
+
+    honest = ChainProvider(chain)
+    honest.reported = []
+    honest.report_evidence = honest.reported.append
+    forked = ForkedWitness(chain, fork_from)
+    lc = _client(chain, witnesses=[honest, forked])
+    lc.primary.reported = []
+    lc.primary.report_evidence = lc.primary.reported.append
+
+    with pytest.raises(ConflictingHeadersError) as ei:
+        lc.verify_light_block_at_height(target)
+    err = ei.value
+    assert err.witness_index == 1, "blamed the honest witness"
+    ev = err.evidence
+    assert isinstance(ev, LightClientAttackEvidence)
+    conflict_h = ev.conflicting_block.height
+    assert conflict_h >= fork_from
+    # anchored where BOTH chains agree — strictly below the divergence
+    assert 1 <= ev.common_height < fork_from
+    # the punishable set is exactly the signing subset
+    byz_want = {v.address
+                for v in chain.valsets[conflict_h - 1].validators[:2]}
+    assert {v.address for v in ev.byzantine_validators} == byz_want
+    # cross-reporting: primary told about the witness fork; the forked
+    # witness told about the primary's chain; honest witness silent
+    assert lc.primary.reported and forked.reported
+    assert lc.primary.reported[0].conflicting_block.header.app_hash \
+        == b"\x77" * 32
+    assert forked.reported[0].conflicting_block.header.app_hash \
+        != b"\x77" * 32
+    assert honest.reported == []
+    # the produced evidence verifies against the common validator set
+    state = State.from_genesis(chain.genesis)
+    common_vals = chain.valsets[ev.common_height - 1]
+    verify_light_client_attack(
+        ev, state, common_vals,
+        chain.blocks[conflict_h - 1].header)

@@ -37,6 +37,7 @@ def _make_net(tmp_path, n=4, timeout_commit=50, skip_timeout_commit=True):
         cfg = Config(root_dir=str(root))
         cfg.base.moniker = f"n{rank}"
         cfg.base.db_backend = "memdb"
+        cfg.rpc.unsafe = True  # route tests drive dial_*/unsafe_flush
         cfg.consensus = ConsensusTimeoutsConfig(
             timeout_propose=500, timeout_propose_delta=250,
             timeout_prevote=250, timeout_prevote_delta=150,
@@ -88,6 +89,26 @@ def test_config_validation_rejects_bad_sections(tmp_path):
     cfg.blocksync.version = "v9"
     with _pytest.raises(ValueError):
         cfg.validate_basic()
+
+
+def test_unsafe_routes_gated_by_config():
+    """dial_seeds/dial_peers/unsafe_flush_mempool exist only with
+    rpc.unsafe=true (reference routes.go:56-62): statesync makes
+    operators expose RPC publicly, and these routes flush mempools and
+    steer peering for any caller."""
+    from cometbft_tpu.rpc.client import RPCClient, RPCClientError
+    from cometbft_tpu.rpc.server import RPCEnvironment, RPCServer
+    srv = RPCServer(RPCEnvironment(chain_id="gate-test"))
+    srv.start()
+    try:
+        c = RPCClient(*srv.addr)
+        for method in ("unsafe_flush_mempool", "dial_seeds",
+                       "dial_peers"):
+            with pytest.raises(RPCClientError):
+                c.call(method)
+        c.call("health")  # safe routes unaffected
+    finally:
+        srv.stop()
 
 
 def test_genesis_file_roundtrip(tmp_path):
@@ -200,6 +221,25 @@ def test_four_node_network_commits_and_serves_rpc(tmp_path):
         assert "dialed" in rpc1.call(
             "dial_seeds",
             seeds=f"{addrs[3][0]}:{addrs[3][1]}")["log"]
+        # tx inclusion proof verifies against the header's data_hash
+        from cometbft_tpu.rpc.codec import proof_from_json
+        from cometbft_tpu.types.block import tx_hash as _txh
+        found = rpc1.call("tx_search", query="tx.height > 0")
+        hsh = found["txs"][0]["hash"]
+        t = rpc1.call("tx", hash=hsh, prove=True)
+        pf = proof_from_json(t["proof"]["proof"])
+        raw_tx = bytes.fromhex(t["tx"])
+        root = bytes.fromhex(t["proof"]["root_hash"])
+        assert pf.verify(root, _txh(raw_tx))
+        hdr = rpc1.call("header", height=t["height"])["header"]
+        assert hdr["data_hash"] == t["proof"]["root_hash"]
+        # validators pagination: page windows tile the full set
+        v1 = rpc1.call("validators", height=1, page=1, per_page=3)
+        v2 = rpc1.call("validators", height=1, page=2, per_page=3)
+        assert v1["total"] == 4 and v1["count"] == 3 and v2["count"] == 1
+        assert len({v["address"] for v in
+                    v1["validators"] + v2["validators"]}) == 4
+
         from test_evidence_gossip import _craft_double_sign
         ev = _craft_double_sign(nodes)
         r = rpc1.call("broadcast_evidence",
