@@ -41,6 +41,14 @@ class DeviceServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  bucket: int = 1024, max_msg_len: int = 256,
                  flush_us: int = 200):
+        import jax
+        first = (jax.config.jax_platforms or "").split(",")[0]
+        if first in ("", "cpu") and bucket > 64:
+            # XLA:CPU crashes (compiler stack overflow) building the
+            # RLC kernel at batch >=256 and takes minutes at 64+
+            # (docs/PERF.md); a CPU-backed dev server clamps rather
+            # than dying inside _warm
+            bucket = 64
         self.bucket = bucket
         self.max_msg_len = max_msg_len
         self.flush_s = flush_us / 1e6

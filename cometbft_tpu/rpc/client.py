@@ -62,4 +62,7 @@ class RPCClient:
                                           if height is not None else {}))
 
     def tx_search(self, query: str, limit: int = 100) -> Dict:
-        return self.call("tx_search", query=query, limit=limit)
+        # per_page must track limit: the route paginates at 30 by
+        # default, which would silently truncate a limit=100 caller
+        return self.call("tx_search", query=query, limit=limit,
+                         per_page=min(int(limit or 30), 100))

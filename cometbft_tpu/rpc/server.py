@@ -246,12 +246,9 @@ class Routes:
                 if self.env.state_store else None)
         if vals is None:
             raise RPCError(-32603, f"no validator set at height {h}")
-        page = max(1, int(page))
-        per_page = min(max(1, int(per_page)), 100)
         js = validator_set_json(vals)
-        total = len(js["validators"])
-        lo = (page - 1) * per_page
-        window = js["validators"][lo:lo + per_page]
+        window, total = self._paginate(js["validators"], page,
+                                       per_page, "asc")
         return {"block_height": h, "validators": window,
                 "proposer": js["proposer"],
                 "count": len(window), "total": total}
@@ -334,30 +331,56 @@ class Routes:
                             "proof": proof_json(proofs[index])}
         return out
 
-    def tx_search(self, query="", limit=None) -> dict:
+    @staticmethod
+    def _paginate(items, page, per_page, order_by):
+        """reference rpc search pagination: 1-based pages, desc option;
+        total_count is the FULL match count, not the window size."""
+        if str(order_by).lower() == "desc":
+            items = list(reversed(items))
+        page = max(1, int(page))
+        per_page = min(max(1, int(per_page)), 100)
+        lo = (page - 1) * per_page
+        return items[lo:lo + per_page], len(items)
+
+    # search results beyond this many matches are not reachable by any
+    # page (an unbounded walk over the postings would let one query pin
+    # the node); total_count saturates at the cap
+    SEARCH_CAP = 10_000
+
+    def tx_search(self, query="", page=1, per_page=30,
+                  order_by="asc", limit=None) -> dict:
         try:
             q = Query(query)
         except QueryError as e:
             raise RPCError(-32602, f"bad query: {e}") from e
         hashes = self.env.tx_indexer.search(
-            q, int(limit) if limit else 100)
-        out = []
+            q, int(limit) if limit else self.SEARCH_CAP)
+        # the indexer returns an unordered match SET: resolve and sort
+        # by (height, index) BEFORE paginating, or page windows would be
+        # hash-seed-dependent (duplicates/gaps across pages)
+        resolved = []
         for hsh in hashes:
             got = self.env.tx_indexer.get(hsh)
             if got:
-                out.append({"hash": hsh.hex().upper(), "height": got[0],
-                            "index": got[1], "tx": got[2].hex()})
-        return {"txs": out, "total_count": len(out)}
+                resolved.append((got[0], got[1], hsh, got[2]))
+        resolved.sort(key=lambda r: (r[0], r[1]))
+        window, total = self._paginate(resolved, page, per_page, order_by)
+        return {"txs": [{"hash": h.hex().upper(), "height": ht,
+                         "index": ix, "tx": raw.hex()}
+                        for ht, ix, h, raw in window],
+                "total_count": total}
 
-    def block_search(self, query="", limit=None) -> dict:
+    def block_search(self, query="", page=1, per_page=30,
+                     order_by="asc", limit=None) -> dict:
         try:
             q = Query(query)
         except QueryError as e:
             raise RPCError(-32602, f"bad query: {e}") from e
         heights = self.env.block_indexer.search(
-            q, int(limit) if limit else 100)
-        return {"blocks": [self.block(h) for h in heights],
-                "total_count": len(heights)}
+            q, int(limit) if limit else self.SEARCH_CAP)
+        window, total = self._paginate(heights, page, per_page, order_by)
+        return {"blocks": [self.block(h) for h in window],
+                "total_count": total}
 
     # --- consensus introspection (rpc/core/consensus.go) ----------------------
 

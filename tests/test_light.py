@@ -352,3 +352,46 @@ def test_two_witness_fork_at_common_height(chain):
     verify_light_client_attack(
         ev, state, common_vals,
         chain.blocks[conflict_h - 1].header)
+
+
+def test_backwards_mismatch_rejected_and_not_stored(chain):
+    """A primary serving a tampered predecessor during the backwards
+    walk must be rejected at the hash link (client.go:934-988), and the
+    tampered block must NOT land in the store (a stored block
+    short-circuits all future verification)."""
+    target = chain.max_height()
+    bad_h = target - 2
+
+    class TamperingPrimary(ChainProvider):
+        armed = False
+
+        def light_block(self, height):
+            lb = super().light_block(height)
+            if self.armed and height == bad_h:
+                from dataclasses import replace
+                hdr = replace(lb.header, app_hash=b"\x13" * 32)
+                return LightBlock(
+                    SignedHeader(hdr, lb.signed_header.commit),
+                    lb.validator_set)
+            return lb
+
+    store = LightStore(MemDB())
+    prov = TamperingPrimary(chain)
+    opts = TrustOptions(period_seconds=TRUST_PERIOD, height=1,
+                        hash=chain.blocks[0].hash())
+    lc = LightClient(chain.chain_id, opts, prov, [], store,
+                     now_fn=lambda: _now(chain))
+    # trust the tip honestly first, then arm the tamper and force the
+    # backwards walk to FETCH the bad height
+    lc.verify_light_block_at_height(target)
+    store.delete(bad_h)
+    store.delete(bad_h + 1)  # the walk must re-fetch the link chain
+    prov.armed = True
+    # rejected either at the commit/header binding (validate_basic) or
+    # at the backwards hash link — both before the block is stored
+    from cometbft_tpu.light.types import LightBlockError
+    with pytest.raises((LightClientError, LightBlockError)):
+        lc.verify_light_block_at_height(bad_h)
+    stored = store.lowest_above(bad_h - 1)
+    assert stored is None or stored.height != bad_h or \
+        stored.header.app_hash != b"\x13" * 32
