@@ -16,6 +16,7 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Optional, Tuple
 
 from ..types import proto
@@ -36,6 +37,12 @@ _M_QUERY = 7
 _M_INIT_CHAIN = 8
 _M_FLUSH = 9
 _M_QUERY_PROVE = 10
+_M_LIST_SNAPSHOTS = 11
+_M_LOAD_SNAPSHOT_CHUNK = 12
+_M_OFFER_SNAPSHOT = 13
+_M_APPLY_SNAPSHOT_CHUNK = 14
+_M_EXTEND_VOTE = 15
+_M_VERIFY_VOTE_EXT = 16
 
 
 def _send_msg(sock, method: int, body: dict) -> None:
@@ -186,6 +193,33 @@ class ABCIServer:
             if pf is not None:
                 out["proof"] = proof_json(pf)
             return out
+        if method == _M_LIST_SNAPSHOTS:
+            return {"snapshots": [
+                {"height": s.height, "format": s.format,
+                 "chunks": s.chunks, "hash": _hx(s.hash),
+                 "metadata": _hx(s.metadata)}
+                for s in app.list_snapshots()]}
+        if method == _M_LOAD_SNAPSHOT_CHUNK:
+            return {"chunk": _hx(app.load_snapshot_chunk(
+                b["height"], b["format"], b["chunk"]))}
+        if method == _M_OFFER_SNAPSHOT:
+            from .application import Snapshot
+            snap = Snapshot(b["snapshot"]["height"],
+                            b["snapshot"]["format"],
+                            b["snapshot"]["chunks"],
+                            _unhx(b["snapshot"]["hash"]),
+                            _unhx(b["snapshot"]["metadata"]))
+            return {"result": app.offer_snapshot(
+                snap, _unhx(b["app_hash"]))}
+        if method == _M_APPLY_SNAPSHOT_CHUNK:
+            return {"result": app.apply_snapshot_chunk(
+                b["index"], _unhx(b["chunk"]), b["sender"])}
+        if method == _M_EXTEND_VOTE:
+            return {"extension": _hx(app.extend_vote(
+                b["height"], b["round"]))}
+        if method == _M_VERIFY_VOTE_EXT:
+            return {"ok": bool(app.verify_vote_extension(
+                b["height"], _unhx(b["addr"]), _unhx(b["ext"])))}
         raise ValueError(f"unknown ABCI method {method}")
 
     def stop(self) -> None:
@@ -201,8 +235,27 @@ class SocketClient:
     abci/client/socket_client.go) — consumers (BlockExecutor, mempool,
     proxy) cannot tell it from an in-process app."""
 
-    def __init__(self, host: str, port: int):
-        self._sock = socket.create_connection((host, port), timeout=30)
+    def __init__(self, host: str, port: int,
+                 connect_retry_s: float = 30.0):
+        # retry the dial: under a process supervisor the app routinely
+        # comes up a moment after the node (the reference socket client
+        # retries the same way)
+        deadline = time.monotonic() + connect_retry_s
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+        # blocking from here on: a per-call timeout would desynchronize
+        # the request/response stream (a late response to a timed-out
+        # call gets read as the answer to the NEXT call — silent wrong
+        # state if the method ids happen to match). Slow ABCI calls
+        # (long finalize_block) must block, not corrupt.
+        self._sock.settimeout(None)
         self._reader = _Reader(self._sock)
         self._lock = threading.Lock()
 
@@ -287,6 +340,45 @@ class SocketClient:
         r = self._call(_M_QUERY_PROVE, {"path": path, "data": _hx(data)})
         return (r["code"], _unhx(r["value"]), r["height"],
                 proof_from_json(r.get("proof")))
+
+    # --- snapshot connection (reference abci/client socket flavor) -------
+
+    def list_snapshots(self):
+        from .application import Snapshot
+        r = self._call(_M_LIST_SNAPSHOTS, {})
+        return [Snapshot(s["height"], s["format"], s["chunks"],
+                         _unhx(s["hash"]), _unhx(s["metadata"]))
+                for s in r["snapshots"]]
+
+    def load_snapshot_chunk(self, height: int, format_: int,
+                            chunk: int) -> bytes:
+        return _unhx(self._call(_M_LOAD_SNAPSHOT_CHUNK, {
+            "height": height, "format": format_, "chunk": chunk})["chunk"])
+
+    def offer_snapshot(self, snapshot, app_hash: bytes) -> str:
+        return self._call(_M_OFFER_SNAPSHOT, {
+            "snapshot": {"height": snapshot.height,
+                         "format": snapshot.format,
+                         "chunks": snapshot.chunks,
+                         "hash": _hx(snapshot.hash),
+                         "metadata": _hx(snapshot.metadata)},
+            "app_hash": _hx(app_hash)})["result"]
+
+    def apply_snapshot_chunk(self, index: int, chunk: bytes,
+                             sender: str) -> str:
+        return self._call(_M_APPLY_SNAPSHOT_CHUNK, {
+            "index": index, "chunk": _hx(chunk),
+            "sender": sender})["result"]
+
+    def extend_vote(self, height: int, round_: int) -> bytes:
+        return _unhx(self._call(_M_EXTEND_VOTE, {
+            "height": height, "round": round_})["extension"])
+
+    def verify_vote_extension(self, height: int, addr: bytes,
+                              ext: bytes) -> bool:
+        return bool(self._call(_M_VERIFY_VOTE_EXT, {
+            "height": height, "addr": _hx(addr),
+            "ext": _hx(ext)})["ok"])
 
     def close(self) -> None:
         try:

@@ -59,7 +59,6 @@ def cmd_init(args) -> int:
 
 def cmd_start(args) -> int:
     """reference commands/run_node.go."""
-    from ..abci.kvstore import KVStoreApplication
     from ..node.node import Node
     cfg = _cfg(args.home)
     if args.p2p_laddr:
@@ -68,6 +67,8 @@ def cmd_start(args) -> int:
         cfg.rpc.laddr = args.rpc_laddr
     if args.persistent_peers:
         cfg.p2p.persistent_peers = args.persistent_peers
+    if getattr(args, "proxy_app", ""):
+        cfg.base.proxy_app = args.proxy_app
     import faulthandler
     import signal as _signal
     faulthandler.register(_signal.SIGUSR1)  # live thread dump for hangs
@@ -77,7 +78,7 @@ def cmd_start(args) -> int:
     # tunnel-pinned) platform config
     from ..libs.jax_cache import enable_compile_cache
     enable_compile_cache()
-    node = Node(cfg, KVStoreApplication())
+    node = Node(cfg)  # app resolved from [base] proxy_app
     node.consensus.on_commit = lambda block, commit: print(
         f"committed height={block.header.height} "
         f"round={commit.round} txs={len(block.data.txs)}", flush=True)
@@ -376,7 +377,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     add("init", cmd_init, chain_id={"default": ""})
     add("start", cmd_start, p2p_laddr={"default": ""},
-        rpc_laddr={"default": ""}, persistent_peers={"default": ""})
+        rpc_laddr={"default": ""}, persistent_peers={"default": ""},
+        proxy_app={"default": ""})
     tn = sub.add_parser("testnet")
     tn.add_argument("--v", type=int, default=4)
     tn.add_argument("--o", default="./testnet")

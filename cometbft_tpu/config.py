@@ -23,6 +23,10 @@ class BaseConfig:
     priv_validator_file: str = "config/priv_validator.json"
     node_key_file: str = "config/node_key.json"
     block_sync: bool = True
+    # "kvstore" = built-in in-process app; "tcp://host:port" or
+    # "host:port" = external ABCI app over the socket protocol
+    # (reference config.go BaseConfig.ProxyApp)
+    proxy_app: str = "kvstore"
 
 
 @dataclass
@@ -166,6 +170,17 @@ class Config:
             raise ValueError("chain_id must be set")
         if self.base.db_backend not in ("memdb", "filedb", "native"):
             raise ValueError(f"unknown db backend {self.base.db_backend}")
+        pa = self.base.proxy_app
+        if pa != "kvstore":
+            # only the built-in app or a tcp socket address are
+            # supported (no unix sockets / other reference app names);
+            # fail at config time, not deep inside node boot
+            addr = pa.removeprefix("tcp://")
+            _host, _, port = addr.rpartition(":")
+            if pa.startswith("unix://") or not port.isdigit():
+                raise ValueError(
+                    f"proxy_app must be 'kvstore' or tcp://host:port, "
+                    f"got {pa!r}")
         for name in ("timeout_propose", "timeout_prevote",
                      "timeout_precommit", "timeout_commit"):
             if getattr(self.consensus, name) < 0:

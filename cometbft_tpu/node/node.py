@@ -84,10 +84,11 @@ def load_genesis(path: str) -> GenesisDoc:
 class Node:
     """reference node/node.go Node."""
 
-    def __init__(self, config: Config, app: Application,
+    def __init__(self, config: Config, app: Optional[Application] = None,
                  genesis: Optional[GenesisDoc] = None,
                  priv_validator: Optional[FilePV] = None,
-                 node_key: Optional[Ed25519PrivKey] = None):
+                 node_key: Optional[Ed25519PrivKey] = None,
+                 client_creator=None):
         config.validate_basic()
         self.config = config
         self.genesis = genesis or load_genesis(
@@ -109,8 +110,25 @@ class Node:
             # the initial height (reference state/store.go Bootstrap)
             self.state_store.save(state)
 
-        # --- proxy app (node.go:319) -----------------------------------------
-        self.app_conns = AppConns(local_client_creator(app))
+        # --- proxy app (node.go:319): in-process app, explicit client
+        # creator, or [base] proxy_app = tcp://host:port (the socket
+        # flavor — reference proxy.DefaultClientCreator) ----------------------
+        if client_creator is None:
+            if app is not None:
+                client_creator = local_client_creator(app)
+            else:
+                target = config.base.proxy_app
+                if target == "kvstore":
+                    from ..abci.kvstore import KVStoreApplication
+                    client_creator = local_client_creator(
+                        KVStoreApplication())
+                else:
+                    from ..proxy.multi_app_conn import (
+                        remote_client_creator)
+                    host, port = self._split_addr(
+                        target.removeprefix("tcp://"))
+                    client_creator = remote_client_creator(host, port)
+        self.app_conns = AppConns(client_creator)
         self._handshake(state)
 
         # --- event bus + indexers (node.go:328-334) --------------------------

@@ -252,3 +252,70 @@ def test_four_node_network_commits_and_serves_rpc(tmp_path):
     finally:
         for nd in nodes:
             nd.stop()
+
+
+def test_node_with_remote_socket_app(tmp_path):
+    """[base] proxy_app = tcp://host:port runs the node against an
+    EXTERNAL ABCI app over the socket protocol (reference
+    commands/run_node.go --proxy_app + abci/client/socket_client.go):
+    consensus, queries, and the snapshot connection all ride the wire."""
+    import os
+
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.socket import ABCIServer
+    from cometbft_tpu.config import Config, ConsensusTimeoutsConfig
+    from cometbft_tpu.node.node import Node, save_genesis
+    from cometbft_tpu.privval.file import FilePV
+    from cometbft_tpu.state.state import GenesisDoc
+    from cometbft_tpu.types.proto import Timestamp
+    from cometbft_tpu.types.validator import Validator
+
+    app = KVStoreApplication()
+    srv = ABCIServer(app)
+    srv.start()
+    node = None
+    try:
+        pv = FilePV.generate(None)
+        gen = GenesisDoc(chain_id="remote-app",
+                         genesis_time=Timestamp.now(),
+                         validators=[Validator(pv.get_pub_key(), 10)])
+        root = tmp_path / "remotenode"
+        os.makedirs(root / "config", exist_ok=True)
+        cfg = Config(root_dir=str(root))
+        cfg.base.db_backend = "memdb"
+        cfg.base.proxy_app = f"tcp://127.0.0.1:{srv.addr[1]}"
+        cfg.consensus = ConsensusTimeoutsConfig(
+            timeout_propose=500, timeout_propose_delta=250,
+            timeout_prevote=250, timeout_prevote_delta=150,
+            timeout_precommit=250, timeout_precommit_delta=150,
+            timeout_commit=50, wal_file="data/cs.wal")
+        save_genesis(gen, str(root / "config/genesis.json"))
+        node = Node(cfg, priv_validator=pv, genesis=gen)
+        node.mempool.check_tx(b"remote=app")
+        node.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if node.consensus.state.last_block_height >= 3 and \
+                    app.query("/store", b"remote")[1] == b"app":
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(
+                f"stuck at {node.consensus.state.last_block_height}")
+        # the query connection rides the wire too
+        code, val = node.app_conns.query.query("/store", b"remote")
+        assert val == b"app"
+        # the snapshot connection's methods ride the wire (interval
+        # snapshots appear at height 5)
+        while node.consensus.state.last_block_height < 6 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        snaps = node.app_conns.snapshot.list_snapshots()
+        assert snaps and snaps[0].height % 5 == 0
+        chunk = node.app_conns.snapshot.load_snapshot_chunk(
+            snaps[0].height, snaps[0].format, 0)
+        assert chunk and b"remote" in chunk
+    finally:
+        if node is not None:
+            node.stop()
+        srv.stop()
