@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field as dc_field
 from typing import Callable, List, Optional, Union
 
@@ -127,7 +128,7 @@ class ConsensusState:
                  executor: BlockExecutor, block_store,
                  priv_validator: Optional[PrivValidator] = None,
                  wal=None, ticker_cls=TimeoutTicker,
-                 name: str = ""):
+                 name: str = "", metrics=None):
         self.config = config
         self.executor = executor
         self.block_store = block_store
@@ -163,6 +164,9 @@ class ConsensusState:
 
         self._priv_pubkey = (priv_validator.get_pub_key()
                              if priv_validator else None)
+        # ConsensusMetrics (reference internal/consensus/metrics.go) —
+        # optional: cluster tests and tools run metric-less
+        self.metrics = metrics
         self._update_to_state(state)
 
     # --- lifecycle -----------------------------------------------------------
@@ -319,6 +323,9 @@ class ConsensusState:
                 .extensions_enabled(height)),
             last_commit=last_precommits,
         )
+        if self.metrics is not None:
+            self.metrics.height.set(state.last_block_height)
+            self.metrics.validators.set(len(state.validators.validators))
 
     def _proposer_for(self, round_: int):
         vals = self.state.validators
@@ -350,6 +357,9 @@ class ConsensusState:
             rs.proposal_receive_time = None
         rs.triggered_timeout_precommit = False
         rs.votes.set_round(round_ + 1)
+        if self.metrics is not None:
+            self.metrics.rounds.inc(
+                reason="new_height" if round_ == 0 else "round_skip")
         self._enter_propose(height, round_)
         self._replay_pending()
 
@@ -726,8 +736,12 @@ class ConsensusState:
             self.wal.write_sync(EndHeightMessage(height))
         fail_point("finalize:post-endheight")        # state.go:1897
 
+        _t0 = time.monotonic()
         new_state, _resp = self.executor.apply_block(
             self.state, bid, block, verified=True)
+        if self.metrics is not None:
+            self.metrics.block_processing.observe(
+                time.monotonic() - _t0)
         self.on_commit(block, seen_commit)
         self._update_to_state(new_state)
         # schedule the NewHeight timeout: gather more precommits before
@@ -783,6 +797,8 @@ class ConsensusState:
             self._add_vote(vote, peer_id)
         except ErrVoteConflictingVotes as err:
             self.conflicting_votes.append(err)
+            if self.metrics is not None:
+                self.metrics.byzantine_validators.inc()
             if self.evidence_pool is not None:
                 self.evidence_pool.add_duplicate_vote(
                     err.vote_a, err.vote_b, self.state)

@@ -48,7 +48,9 @@ class Counter(_Metric):
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
-        for k, v in sorted(self._values.items()):
+        with self._lock:  # writers insert label keys concurrently
+            items = sorted(self._values.items())
+        for k, v in items:
             out.append(f"{self.name}"
                        f"{self._fmt_labels(self.label_names, k)} {v}")
         return out
@@ -74,7 +76,9 @@ class Gauge(_Metric):
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} gauge"]
-        for k, v in sorted(self._values.items()):
+        with self._lock:  # writers insert label keys concurrently
+            items = sorted(self._values.items())
+        for k, v in items:
             out.append(f"{self.name}"
                        f"{self._fmt_labels(self.label_names, k)} {v}")
         return out
@@ -105,7 +109,10 @@ class Histogram(_Metric):
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
-        for k, counts in sorted(self._counts.items()):
+        with self._lock:  # snapshot: observe() mutates concurrently
+            items = [(k, list(c), self._sums[k])
+                     for k, c in sorted(self._counts.items())]
+        for k, counts, total_sum in items:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += counts[i]
@@ -119,7 +126,7 @@ class Histogram(_Metric):
                        f"{self._fmt_labels(names, k + ('+Inf',))} {total}")
             out.append(f"{self.name}_sum"
                        f"{self._fmt_labels(self.label_names, k)} "
-                       f"{self._sums[k]}")
+                       f"{total_sum}")
             out.append(f"{self.name}_count"
                        f"{self._fmt_labels(self.label_names, k)} {total}")
         return out

@@ -163,6 +163,8 @@ class Node:
             self.block_store, self.state_store,
             interval_s=config.storage.pruning_interval_ms / 1000.0)
         self.executor.pruner = self.pruner
+        from ..libs.metrics import ConsensusMetrics, Registry
+        self.metrics_registry = Registry()
         cc = config.consensus
         self.consensus = ConsensusState(
             ConsensusConfig(
@@ -178,7 +180,8 @@ class Node:
             state, self.executor, self.block_store,
             priv_validator=self.priv_validator,
             wal=WAL(config.path(cc.wal_file)),
-            name=config.base.moniker)
+            name=config.base.moniker,
+            metrics=ConsensusMetrics(self.metrics_registry))
         self.consensus.evidence_pool = self.evidence_pool
 
         # --- reactors + switch (node.go:456-494) -----------------------------
@@ -269,6 +272,8 @@ class Node:
             self.indexer_service.start()
         self.pruner.start()
         self.consensus_reactor.start_reconciler()
+        if self.config.instrumentation.prometheus:
+            self._start_metrics_server()
         host, port = self._split_addr(self.config.p2p.laddr)
         self.p2p_addr = self.switch.listen(host, port)
         for peer in filter(None, self.config.p2p.persistent_peers.split(",")):
@@ -416,9 +421,43 @@ class Node:
             return stored
         return fallback
 
+    def _start_metrics_server(self) -> None:
+        """Serve Registry.expose() at [instrumentation] prometheus_laddr
+        (reference node.go Prometheus metrics server)."""
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        registry = self.metrics_registry
+
+        class Handler(BaseHTTPRequestHandler):
+            timeout = 10  # a stalled scraper must not wedge shutdown
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = registry.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        host, port = self._split_addr(
+            self.config.instrumentation.prometheus_laddr or
+            "127.0.0.1:0")
+        self._metrics_server = ThreadingHTTPServer((host, port), Handler)
+        self._metrics_server.daemon_threads = True
+        self.metrics_addr = self._metrics_server.server_address
+        threading.Thread(target=self._metrics_server.serve_forever,
+                         name="metrics", daemon=True).start()
+
     def stop(self) -> None:
         self.consensus.stop()
         self.consensus_reactor.stop()
+        if getattr(self, "_metrics_server", None) is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server.server_close()  # free the listen FD
         self.switch.stop()
         self.pruner.stop()
         self.indexer_service.stop()

@@ -319,3 +319,51 @@ def test_node_with_remote_socket_app(tmp_path):
         if node is not None:
             node.stop()
         srv.stop()
+
+
+def test_prometheus_metrics_endpoint(tmp_path):
+    """[instrumentation] prometheus=true serves live consensus metrics
+    over HTTP in the Prometheus text format (reference node.go metrics
+    server + internal/consensus/metrics.go): height/rounds/validators
+    move with the chain."""
+    import urllib.request
+
+    from cometbft_tpu.types.proto import Timestamp
+
+    pv = FilePV.generate(None)
+    gen = GenesisDoc(chain_id="metrics-net",
+                     genesis_time=Timestamp.now(),
+                     validators=[Validator(pv.get_pub_key(), 10)])
+    root = tmp_path / "metricsnode"
+    os.makedirs(root / "config", exist_ok=True)
+    cfg = Config(root_dir=str(root))
+    cfg.base.db_backend = "memdb"
+    cfg.instrumentation.prometheus = True
+    cfg.consensus = ConsensusTimeoutsConfig(
+        timeout_propose=500, timeout_propose_delta=250,
+        timeout_prevote=250, timeout_prevote_delta=150,
+        timeout_precommit=250, timeout_precommit_delta=150,
+        timeout_commit=50, wal_file="data/cs.wal")
+    save_genesis(gen, str(root / "config/genesis.json"))
+    node = Node(cfg, KVStoreApplication(), genesis=gen,
+                priv_validator=pv)
+    try:
+        node.start()
+        deadline = time.monotonic() + 60
+        while node.consensus.state.last_block_height < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        host, port = node.metrics_addr
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "# TYPE cometbft_tpu_consensus_height gauge" in body
+        h = [ln for ln in body.splitlines()
+             if ln.startswith("cometbft_tpu_consensus_height ")][0]
+        assert float(h.split()[-1]) >= 3
+        assert "cometbft_tpu_consensus_validators 1" in body
+        assert 'cometbft_tpu_consensus_rounds{reason="new_height"}' \
+            in body
+        assert "consensus_block_processing_seconds_count" in body
+    finally:
+        node.stop()
