@@ -116,3 +116,19 @@ class HTTPProvider:
             raise ErrLightBlockNotFound(
                 f"height {height}: {e}") from e
         return LightBlock(sh, vals)
+
+    def report_evidence(self, ev) -> None:
+        """reference light/provider/http ReportEvidence: hand detector
+        evidence to the full node's /broadcast_evidence route, whence
+        the evidence reactor gossips it to every proposer. Failures
+        surface as ProviderError — the detector's _report treats that
+        as best-effort (light/client.py), while direct callers see the
+        actual rejection. ValueError covers a byzantine endpoint
+        answering 200 with a non-JSON body (same defense as
+        light_block above)."""
+        from ..rpc.client import RPCClientError
+        try:
+            self._rpc.call("broadcast_evidence",
+                           evidence=ev.encode().hex())
+        except (RPCClientError, OSError, KeyError, ValueError) as e:
+            raise ProviderError(f"report_evidence: {e}") from e

@@ -106,6 +106,26 @@ def test_evidence_gossips_and_commits(tmp_path):
             # pool marked it committed: no longer pending anywhere
             assert ev.hash() not in {e.hash() for e in
                                      nd.evidence_pool.pending_evidence()}
+
+        # a LIGHT CLIENT's detector reports over RPC (reference
+        # light/provider/http ReportEvidence → /broadcast_evidence):
+        # evidence handed to node 2's route must gossip to node 3
+        from cometbft_tpu.light.provider import HTTPProvider
+        from cometbft_tpu.rpc.client import RPCClient
+        ev2 = _craft_double_sign(nodes, height=2)
+        prov = HTTPProvider(nodes[0].genesis.chain_id,
+                            RPCClient(*nodes[2].rpc_server.addr))
+        prov.report_evidence(ev2)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pending = {e.hash() for e in
+                       nodes[3].evidence_pool.pending_evidence()}
+            committed = nodes[3].evidence_pool._committed
+            if ev2.hash() in pending or ev2.hash() in committed:
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("reported evidence never reached node 3")
     finally:
         for nd in nodes:
             nd.stop()
