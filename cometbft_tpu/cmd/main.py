@@ -303,6 +303,37 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_abci_cli(args) -> int:
+    """Minimal abci-cli (reference abci/cmd/abci-cli): poke an ABCI
+    socket server — echo / info / query / check_tx — for debugging
+    external apps before pointing a node at them."""
+    from ..abci.socket import SocketClient
+    host, _, port = args.address.removeprefix("tcp://").rpartition(":")
+    c = SocketClient(host or "127.0.0.1", int(port),
+                     connect_retry_s=5.0)
+    try:
+        if args.abci_command == "echo":
+            print(c.echo(args.arg or "hello"))
+        elif args.abci_command == "info":
+            i = c.info()
+            print(f"data={i.data} version={i.version} "
+                  f"height={i.last_block_height} "
+                  f"app_hash={i.last_block_app_hash.hex()}")
+        elif args.abci_command == "query":
+            code, value = c.query(args.path, (args.arg or "").encode())
+            print(f"code={code} value={value!r}")
+        elif args.abci_command == "check_tx":
+            r = c.check_tx((args.arg or "").encode())
+            print(f"code={r.code} log={r.log!r}")
+        else:
+            print(f"unknown abci command {args.abci_command!r} "
+                  f"(echo|info|query|check_tx)", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        c.close()
+
+
 def cmd_device_server(args) -> int:
     from ..device.server import main as device_main
     return device_main(["--laddr", args.laddr,
@@ -408,6 +439,12 @@ def build_parser() -> argparse.ArgumentParser:
     lt.add_argument("--trust-period", dest="trust_period", type=int,
                     default=168 * 3600)
     lt.set_defaults(fn=cmd_light)
+    ac = sub.add_parser("abci-cli")
+    ac.add_argument("abci_command")
+    ac.add_argument("arg", nargs="?", default="")
+    ac.add_argument("--address", default="tcp://127.0.0.1:26658")
+    ac.add_argument("--path", default="/store")
+    ac.set_defaults(fn=cmd_abci_cli)
     dv = sub.add_parser("device-server")
     dv.add_argument("--laddr", default="127.0.0.1:28657")
     dv.add_argument("--bucket", type=int, default=1024)

@@ -175,3 +175,37 @@ def test_executor_fires_events():
     assert attrs["block.height"] == ["1"]
     ev, attrs = sub_tx.next(1)
     assert attrs["tx.height"] == ["1"]
+
+
+def test_abci_cli_drives_socket_server():
+    """`abci-cli` (reference abci/cmd/abci-cli): echo/info/query/
+    check_tx against a live ABCI socket server."""
+    import os
+    import subprocess
+    import sys
+
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.socket import ABCIServer
+
+    app = KVStoreApplication()
+    app.state = {"k": "v"}
+    app.last_height = 1
+    srv = ABCIServer(app)
+    srv.start()
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        addr = f"tcp://127.0.0.1:{srv.addr[1]}"
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for cmdline, want in [(["echo", "hi"], "hi"),
+                              (["info"], "data=kvstore-tpu"),
+                              (["query", "k"], "value=b'v'"),
+                              (["check_tx", "a=b"], "code=0")]:
+            r = subprocess.run(
+                [sys.executable, "-m", "cometbft_tpu.cmd.main",
+                 "abci-cli"] + cmdline + ["--address", addr],
+                capture_output=True, text=True, timeout=60,
+                env=env, cwd=root)
+            assert r.returncode == 0 and want in r.stdout, \
+                (cmdline, r.stdout, r.stderr)
+    finally:
+        srv.stop()
