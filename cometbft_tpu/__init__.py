@@ -1,0 +1,12 @@
+"""cometbft_tpu — a TPU-native BFT replication engine with CometBFT's
+capabilities (reference version/version.go for the protocol versions
+reported by the gRPC VersionService)."""
+
+__version__ = "0.4.0"
+
+# protocol versions (reference version/version.go:5-18) — these version
+# wire behavior, not the codebase: block structures and p2p semantics
+# follow the reference's consensus-critical rules
+ABCI_SEM_VER = "2.0.0"
+P2P_PROTOCOL = 9
+BLOCK_PROTOCOL = 11

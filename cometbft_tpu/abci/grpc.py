@@ -1,0 +1,161 @@
+"""ABCI over gRPC: server hosting an Application and the matching
+client (reference abci/server/grpc_server.go, abci/client/grpc_client.go,
+api/cometbft/abci/v1/service.pb.go ABCIService).
+
+Surface parity is by fully-qualified method name — the service is
+`cometbft.abci.v1.ABCIService` with the reference's sixteen unary
+methods. Message bodies reuse the transport-independent JSON codec
+shared with the socket flavor (abci/socket.py `dispatch_request` /
+`AppClientCodec`): both of this framework's transports are in-tree, so
+the codec is node-local by design, exactly as the socket flavor
+documents. The two Query shapes (plain / with proof) multiplex on a
+`prove` flag in the body, mirroring the reference's
+QueryRequest.prove field.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+
+import grpc
+
+from .application import Application
+from .socket import (AppClientCodec, dispatch_request,
+                     _M_ECHO, _M_FLUSH, _M_INFO, _M_CHECK_TX, _M_PREPARE,
+                     _M_PROCESS, _M_FINALIZE, _M_COMMIT, _M_QUERY,
+                     _M_INIT_CHAIN, _M_QUERY_PROVE, _M_LIST_SNAPSHOTS,
+                     _M_LOAD_SNAPSHOT_CHUNK, _M_OFFER_SNAPSHOT,
+                     _M_APPLY_SNAPSHOT_CHUNK, _M_EXTEND_VOTE,
+                     _M_VERIFY_VOTE_EXT)
+
+SERVICE_NAME = "cometbft.abci.v1.ABCIService"
+
+# reference service.pb.go ABCIServiceServer method set. _M_QUERY_PROVE
+# shares the "Query" RPC (the body's `prove` flag picks the app call,
+# like QueryRequest.prove).
+_METHOD_IDS = {
+    "Echo": _M_ECHO,
+    "Flush": _M_FLUSH,
+    "Info": _M_INFO,
+    "CheckTx": _M_CHECK_TX,
+    "Query": _M_QUERY,
+    "Commit": _M_COMMIT,
+    "InitChain": _M_INIT_CHAIN,
+    "ListSnapshots": _M_LIST_SNAPSHOTS,
+    "OfferSnapshot": _M_OFFER_SNAPSHOT,
+    "LoadSnapshotChunk": _M_LOAD_SNAPSHOT_CHUNK,
+    "ApplySnapshotChunk": _M_APPLY_SNAPSHOT_CHUNK,
+    "PrepareProposal": _M_PREPARE,
+    "ProcessProposal": _M_PROCESS,
+    "ExtendVote": _M_EXTEND_VOTE,
+    "VerifyVoteExtension": _M_VERIFY_VOTE_EXT,
+    "FinalizeBlock": _M_FINALIZE,
+}
+_GRPC_NAMES = {mid: name for name, mid in _METHOD_IDS.items()}
+_GRPC_NAMES[_M_QUERY_PROVE] = "Query"
+
+
+def _ser(body: dict) -> bytes:
+    return json.dumps(body).encode()
+
+
+def _de(raw: bytes) -> dict:
+    return json.loads(raw or b"{}")
+
+
+class GRPCServer:
+    """Hosts an Application for remote consensus engines over gRPC
+    (reference abci/server/grpc_server.go GRPCServer)."""
+
+    def __init__(self, app: Application, host: str = "127.0.0.1",
+                 port: int = 0, max_workers: int = 8):
+        self.app = app
+        # gRPC handlers run concurrently; the app contract is a
+        # serialized request stream (same global ordering the socket
+        # server enforces across its 4 named connections)
+        self._app_lock = threading.Lock()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers,
+                thread_name_prefix="abci-grpc"))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(
+                SERVICE_NAME,
+                {name: grpc.unary_unary_rpc_method_handler(
+                    self._make_handler(name),
+                    request_deserializer=_de, response_serializer=_ser)
+                 for name in _METHOD_IDS}),))
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise OSError(
+                f"ABCI gRPC server could not bind {host}:{port}")
+        self.addr = (host, bound)
+
+    def _make_handler(self, name: str):
+        method = _METHOD_IDS[name]
+
+        def handle(body: dict, context):
+            mid = method
+            if name == "Query" and body.pop("prove", False):
+                mid = _M_QUERY_PROVE
+            try:
+                with self._app_lock:
+                    return dispatch_request(self.app, mid, body)
+            except Exception as e:  # noqa: BLE001 — surface app errors
+                # as gRPC status instead of a dropped stream
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+        return handle
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+class GRPCClient(AppClientCodec):
+    """Application-shaped proxy over a gRPC channel (reference
+    abci/client/grpc_client.go)."""
+
+    def __init__(self, host: str, port: int,
+                 connect_retry_s: float = 30.0):
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        # the reference gRPC client dials with retry too: under a
+        # process supervisor the app routinely comes up after the node
+        try:
+            grpc.channel_ready_future(self._channel).result(
+                timeout=connect_retry_s)
+        except grpc.FutureTimeoutError:
+            self._channel.close()
+            raise ConnectionError(
+                f"ABCI gRPC app at {host}:{port} not reachable "
+                f"within {connect_retry_s}s")
+        self._stubs = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=_ser, response_deserializer=_de)
+            for name in _METHOD_IDS}
+
+    def _call(self, method: int, body: dict) -> dict:
+        name = _GRPC_NAMES[method]
+        if method == _M_QUERY_PROVE:
+            body = dict(body, prove=True)
+        try:
+            return self._stubs[name](body)
+        except grpc.RpcError as e:
+            raise ConnectionError(
+                f"ABCI gRPC {name}: {e.code().name}: {e.details()}")
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def serve_app(app: Application, host: str = "127.0.0.1",
+              port: int = 0) -> GRPCServer:
+    """Convenience used by `cmd abci-cli`-style tooling and tests."""
+    srv = GRPCServer(app, host, port)
+    srv.start()
+    return srv

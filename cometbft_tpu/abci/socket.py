@@ -8,6 +8,10 @@ both in-tree; the reference's gogoproto Request/Response envelope plays
 the same role). Requests are processed strictly in order per connection,
 matching the reference's ordered-response contract
 (socket_client.go didn't multiplex either).
+
+The method-id/body codec is transport-independent: `dispatch_request`
+(app side) and `AppClientCodec` (client side) are shared with the gRPC
+flavor (abci/grpc.py), so both transports speak byte-identical bodies.
 """
 
 from __future__ import annotations
@@ -85,6 +89,102 @@ def _unhx(s: str) -> bytes:
     return bytes.fromhex(s)
 
 
+def dispatch_request(app: Application, method: int, b: dict) -> dict:
+    """App-side method dispatch: decode the JSON body, call the
+    Application, encode the response body. Shared by the socket server
+    (below) and the gRPC server (abci/grpc.py) — one codec, two
+    transports (the reference's gogoproto Request/Response oneof plays
+    this role for its socket AND grpc servers)."""
+    if method in (_M_ECHO, _M_FLUSH):
+        return b
+    if method == _M_INFO:
+        r = app.info()
+        return {"data": r.data, "version": r.version,
+                "app_version": r.app_version,
+                "last_block_height": r.last_block_height,
+                "last_block_app_hash": _hx(r.last_block_app_hash)}
+    if method == _M_CHECK_TX:
+        r = app.check_tx(_unhx(b["tx"]))
+        return {"code": r.code, "gas_wanted": r.gas_wanted,
+                "log": r.log}
+    if method == _M_PREPARE:
+        llc = b.get("local_last_commit")
+        if llc is not None:
+            llc = [(e["index"], _unhx(e["address"]),
+                    _unhx(e["extension"])) for e in llc]
+        txs = app.prepare_proposal([_unhx(t) for t in b["txs"]],
+                                   b["max_tx_bytes"],
+                                   local_last_commit=llc)
+        return {"txs": [_hx(t) for t in txs]}
+    if method == _M_PROCESS:
+        ok = app.process_proposal([_unhx(t) for t in b["txs"]],
+                                  b["height"])
+        return {"accept": bool(ok)}
+    if method == _M_INIT_CHAIN:
+        vals = [ValidatorUpdate(v["type"], _unhx(v["pub_key"]),
+                                v["power"])
+                for v in b.get("validators", [])]
+        updates, app_hash = app.init_chain(
+            b["chain_id"], b["initial_height"], vals,
+            _unhx(b["app_state"]))
+        return {"app_hash": _hx(app_hash),
+                "updates": [{"type": u.pub_key_type,
+                             "pub_key": _hx(u.pub_key_bytes),
+                             "power": u.power} for u in updates]}
+    if method == _M_FINALIZE:
+        req = RequestFinalizeBlock(
+            txs=[_unhx(t) for t in b["txs"]],
+            height=b["height"],
+            time=Timestamp(b["time_s"], b["time_ns"]),
+            proposer_address=_unhx(b["proposer"]),
+            hash=_unhx(b["hash"]),
+            next_validators_hash=_unhx(b["next_vals"]))
+        r = app.finalize_block(req)
+        return json.loads(r.encode())
+    if method == _M_COMMIT:
+        r = app.commit()
+        return {"retain_height": r.retain_height}
+    if method == _M_QUERY:
+        code, value = app.query(b["path"], _unhx(b["data"]))
+        return {"code": code, "value": _hx(value)}
+    if method == _M_QUERY_PROVE:
+        from ..rpc.codec import proof_json
+        code, value, height, pf = app.query_prove(
+            b["path"], _unhx(b["data"]))
+        out = {"code": code, "value": _hx(value), "height": height}
+        if pf is not None:
+            out["proof"] = proof_json(pf)
+        return out
+    if method == _M_LIST_SNAPSHOTS:
+        return {"snapshots": [
+            {"height": s.height, "format": s.format,
+             "chunks": s.chunks, "hash": _hx(s.hash),
+             "metadata": _hx(s.metadata)}
+            for s in app.list_snapshots()]}
+    if method == _M_LOAD_SNAPSHOT_CHUNK:
+        return {"chunk": _hx(app.load_snapshot_chunk(
+            b["height"], b["format"], b["chunk"]))}
+    if method == _M_OFFER_SNAPSHOT:
+        from .application import Snapshot
+        snap = Snapshot(b["snapshot"]["height"],
+                        b["snapshot"]["format"],
+                        b["snapshot"]["chunks"],
+                        _unhx(b["snapshot"]["hash"]),
+                        _unhx(b["snapshot"]["metadata"]))
+        return {"result": app.offer_snapshot(
+            snap, _unhx(b["app_hash"]))}
+    if method == _M_APPLY_SNAPSHOT_CHUNK:
+        return {"result": app.apply_snapshot_chunk(
+            b["index"], _unhx(b["chunk"]), b["sender"])}
+    if method == _M_EXTEND_VOTE:
+        return {"extension": _hx(app.extend_vote(
+            b["height"], b["round"]))}
+    if method == _M_VERIFY_VOTE_EXT:
+        return {"ok": bool(app.verify_vote_extension(
+            b["height"], _unhx(b["addr"]), _unhx(b["ext"])))}
+    raise ValueError(f"unknown ABCI method {method}")
+
+
 class ABCIServer:
     """Hosts an Application for remote consensus engines (reference
     abci/server/socket_server.go)."""
@@ -132,95 +232,7 @@ class ABCIServer:
                 pass
 
     def _handle(self, method: int, b: dict) -> dict:
-        app = self.app
-        if method in (_M_ECHO, _M_FLUSH):
-            return b
-        if method == _M_INFO:
-            r = app.info()
-            return {"data": r.data, "version": r.version,
-                    "app_version": r.app_version,
-                    "last_block_height": r.last_block_height,
-                    "last_block_app_hash": _hx(r.last_block_app_hash)}
-        if method == _M_CHECK_TX:
-            r = app.check_tx(_unhx(b["tx"]))
-            return {"code": r.code, "gas_wanted": r.gas_wanted,
-                    "log": r.log}
-        if method == _M_PREPARE:
-            llc = b.get("local_last_commit")
-            if llc is not None:
-                llc = [(e["index"], _unhx(e["address"]),
-                        _unhx(e["extension"])) for e in llc]
-            txs = app.prepare_proposal([_unhx(t) for t in b["txs"]],
-                                       b["max_tx_bytes"],
-                                       local_last_commit=llc)
-            return {"txs": [_hx(t) for t in txs]}
-        if method == _M_PROCESS:
-            ok = app.process_proposal([_unhx(t) for t in b["txs"]],
-                                      b["height"])
-            return {"accept": bool(ok)}
-        if method == _M_INIT_CHAIN:
-            vals = [ValidatorUpdate(v["type"], _unhx(v["pub_key"]),
-                                    v["power"])
-                    for v in b.get("validators", [])]
-            updates, app_hash = app.init_chain(
-                b["chain_id"], b["initial_height"], vals,
-                _unhx(b["app_state"]))
-            return {"app_hash": _hx(app_hash),
-                    "updates": [{"type": u.pub_key_type,
-                                 "pub_key": _hx(u.pub_key_bytes),
-                                 "power": u.power} for u in updates]}
-        if method == _M_FINALIZE:
-            req = RequestFinalizeBlock(
-                txs=[_unhx(t) for t in b["txs"]],
-                height=b["height"],
-                time=Timestamp(b["time_s"], b["time_ns"]),
-                proposer_address=_unhx(b["proposer"]),
-                hash=_unhx(b["hash"]),
-                next_validators_hash=_unhx(b["next_vals"]))
-            r = app.finalize_block(req)
-            return json.loads(r.encode())
-        if method == _M_COMMIT:
-            r = app.commit()
-            return {"retain_height": r.retain_height}
-        if method == _M_QUERY:
-            code, value = app.query(b["path"], _unhx(b["data"]))
-            return {"code": code, "value": _hx(value)}
-        if method == _M_QUERY_PROVE:
-            from ..rpc.codec import proof_json
-            code, value, height, pf = app.query_prove(
-                b["path"], _unhx(b["data"]))
-            out = {"code": code, "value": _hx(value), "height": height}
-            if pf is not None:
-                out["proof"] = proof_json(pf)
-            return out
-        if method == _M_LIST_SNAPSHOTS:
-            return {"snapshots": [
-                {"height": s.height, "format": s.format,
-                 "chunks": s.chunks, "hash": _hx(s.hash),
-                 "metadata": _hx(s.metadata)}
-                for s in app.list_snapshots()]}
-        if method == _M_LOAD_SNAPSHOT_CHUNK:
-            return {"chunk": _hx(app.load_snapshot_chunk(
-                b["height"], b["format"], b["chunk"]))}
-        if method == _M_OFFER_SNAPSHOT:
-            from .application import Snapshot
-            snap = Snapshot(b["snapshot"]["height"],
-                            b["snapshot"]["format"],
-                            b["snapshot"]["chunks"],
-                            _unhx(b["snapshot"]["hash"]),
-                            _unhx(b["snapshot"]["metadata"]))
-            return {"result": app.offer_snapshot(
-                snap, _unhx(b["app_hash"]))}
-        if method == _M_APPLY_SNAPSHOT_CHUNK:
-            return {"result": app.apply_snapshot_chunk(
-                b["index"], _unhx(b["chunk"]), b["sender"])}
-        if method == _M_EXTEND_VOTE:
-            return {"extension": _hx(app.extend_vote(
-                b["height"], b["round"]))}
-        if method == _M_VERIFY_VOTE_EXT:
-            return {"ok": bool(app.verify_vote_extension(
-                b["height"], _unhx(b["addr"]), _unhx(b["ext"])))}
-        raise ValueError(f"unknown ABCI method {method}")
+        return dispatch_request(self.app, method, b)
 
     def stop(self) -> None:
         self._stop.set()
@@ -230,43 +242,15 @@ class ABCIServer:
             pass
 
 
-class SocketClient:
-    """Application-shaped proxy over a socket (reference
-    abci/client/socket_client.go) — consumers (BlockExecutor, mempool,
-    proxy) cannot tell it from an in-process app."""
-
-    def __init__(self, host: str, port: int,
-                 connect_retry_s: float = 30.0):
-        # retry the dial: under a process supervisor the app routinely
-        # comes up a moment after the node (the reference socket client
-        # retries the same way)
-        deadline = time.monotonic() + connect_retry_s
-        while True:
-            try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=5)
-                break
-            except OSError:
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.5)
-        # blocking from here on: a per-call timeout would desynchronize
-        # the request/response stream (a late response to a timed-out
-        # call gets read as the answer to the NEXT call — silent wrong
-        # state if the method ids happen to match). Slow ABCI calls
-        # (long finalize_block) must block, not corrupt.
-        self._sock.settimeout(None)
-        self._reader = _Reader(self._sock)
-        self._lock = threading.Lock()
+class AppClientCodec:
+    """Application-shaped client over an abstract `_call(method, body)`
+    transport. SocketClient supplies the framed-socket transport below;
+    GRPCClient (abci/grpc.py) supplies the gRPC one — consumers
+    (BlockExecutor, mempool, proxy) cannot tell either from an
+    in-process app."""
 
     def _call(self, method: int, body: dict) -> dict:
-        with self._lock:
-            _send_msg(self._sock, method, body)
-            got_method, resp = self._reader.read_msg()
-            if got_method != method:
-                raise ConnectionError(
-                    f"out-of-order ABCI response {got_method} != {method}")
-            return resp
+        raise NotImplementedError
 
     # --- Application interface ------------------------------------------------
 
@@ -379,6 +363,44 @@ class SocketClient:
         return bool(self._call(_M_VERIFY_VOTE_EXT, {
             "height": height, "addr": _hx(addr),
             "ext": _hx(ext)})["ok"])
+
+
+class SocketClient(AppClientCodec):
+    """Framed-socket transport for AppClientCodec (reference
+    abci/client/socket_client.go)."""
+
+    def __init__(self, host: str, port: int,
+                 connect_retry_s: float = 30.0):
+        # retry the dial: under a process supervisor the app routinely
+        # comes up a moment after the node (the reference socket client
+        # retries the same way)
+        deadline = time.monotonic() + connect_retry_s
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+        # blocking from here on: a per-call timeout would desynchronize
+        # the request/response stream (a late response to a timed-out
+        # call gets read as the answer to the NEXT call — silent wrong
+        # state if the method ids happen to match). Slow ABCI calls
+        # (long finalize_block) must block, not corrupt.
+        self._sock.settimeout(None)
+        self._reader = _Reader(self._sock)
+        self._lock = threading.Lock()
+
+    def _call(self, method: int, body: dict) -> dict:
+        with self._lock:
+            _send_msg(self._sock, method, body)
+            got_method, resp = self._reader.read_msg()
+            if got_method != method:
+                raise ConnectionError(
+                    f"out-of-order ABCI response {got_method} != {method}")
+            return resp
 
     def close(self) -> None:
         try:

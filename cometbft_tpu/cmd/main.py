@@ -305,12 +305,20 @@ def cmd_light(args) -> int:
 
 def cmd_abci_cli(args) -> int:
     """Minimal abci-cli (reference abci/cmd/abci-cli): poke an ABCI
-    socket server — echo / info / query / check_tx — for debugging
-    external apps before pointing a node at them."""
-    from ..abci.socket import SocketClient
-    host, _, port = args.address.removeprefix("tcp://").rpartition(":")
-    c = SocketClient(host or "127.0.0.1", int(port),
-                     connect_retry_s=5.0)
+    server — echo / info / query / check_tx — for debugging external
+    apps before pointing a node at them. grpc:// addresses use the
+    gRPC transport (reference abci-cli --abci grpc)."""
+    addr = args.address
+    if addr.startswith("grpc://"):
+        from ..abci.grpc import GRPCClient
+        host, _, port = addr.removeprefix("grpc://").rpartition(":")
+        c = GRPCClient(host or "127.0.0.1", int(port),
+                       connect_retry_s=5.0)
+    else:
+        from ..abci.socket import SocketClient
+        host, _, port = addr.removeprefix("tcp://").rpartition(":")
+        c = SocketClient(host or "127.0.0.1", int(port),
+                         connect_retry_s=5.0)
     try:
         if args.abci_command == "echo":
             print(c.echo(args.arg or "hello"))

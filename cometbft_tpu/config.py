@@ -143,6 +143,26 @@ class TxIndexConfig:
 
 
 @dataclass
+class GRPCConfig:
+    """reference config/config.go GRPCConfig: the companion gRPC
+    surface. Empty laddr = disabled (the reference's default)."""
+    laddr: str = ""
+    version_service: bool = True
+    block_service: bool = True
+    block_results_service: bool = True
+    # the privileged listener (reference GRPCPrivilegedConfig) is a
+    # SEPARATE port: it exposes pruning control, which must not ride
+    # the publicly-exposable laddr above
+    privileged_laddr: str = ""
+    pruning_service: bool = False
+
+    def validate_basic(self) -> None:
+        if self.pruning_service and not self.privileged_laddr:
+            raise ValueError(
+                "grpc pruning_service requires privileged_laddr")
+
+
+@dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_laddr: str = ""
@@ -161,6 +181,7 @@ class Config:
         default_factory=ConsensusTimeoutsConfig)
     storage: StorageConfig = dc_field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = dc_field(default_factory=TxIndexConfig)
+    grpc: GRPCConfig = dc_field(default_factory=GRPCConfig)
     instrumentation: InstrumentationConfig = dc_field(
         default_factory=InstrumentationConfig)
     root_dir: str = "."
@@ -172,15 +193,15 @@ class Config:
             raise ValueError(f"unknown db backend {self.base.db_backend}")
         pa = self.base.proxy_app
         if pa != "kvstore":
-            # only the built-in app or a tcp socket address are
-            # supported (no unix sockets / other reference app names);
-            # fail at config time, not deep inside node boot
-            addr = pa.removeprefix("tcp://")
+            # the built-in app, a tcp socket address, or a grpc address
+            # (reference config.go ABCI = socket | grpc); no unix
+            # sockets — fail at config time, not deep inside node boot
+            addr = pa.removeprefix("tcp://").removeprefix("grpc://")
             _host, _, port = addr.rpartition(":")
             if pa.startswith("unix://") or not port.isdigit():
                 raise ValueError(
-                    f"proxy_app must be 'kvstore' or tcp://host:port, "
-                    f"got {pa!r}")
+                    f"proxy_app must be 'kvstore', tcp://host:port or "
+                    f"grpc://host:port, got {pa!r}")
         for name in ("timeout_propose", "timeout_prevote",
                      "timeout_precommit", "timeout_commit"):
             if getattr(self.consensus, name) < 0:
@@ -189,6 +210,7 @@ class Config:
         self.blocksync.validate_basic()
         self.storage.validate_basic()
         self.tx_index.validate_basic()
+        self.grpc.validate_basic()
 
     def path(self, rel: str) -> str:
         return os.path.join(self.root_dir, rel)
@@ -218,6 +240,7 @@ class Config:
             emit("consensus", self.consensus),
             emit("storage", self.storage),
             emit("tx_index", self.tx_index),
+            emit("grpc", self.grpc),
             emit("instrumentation", self.instrumentation)]) + "\n"
 
     @classmethod
@@ -233,6 +256,7 @@ class Config:
                                 ("consensus", cfg.consensus),
                                 ("storage", cfg.storage),
                                 ("tx_index", cfg.tx_index),
+                                ("grpc", cfg.grpc),
                                 ("instrumentation", cfg.instrumentation)):
             for k, v in d.get(section, {}).items():
                 if hasattr(target, k):

@@ -73,6 +73,30 @@ class TxIndexer:
                 return []
         return list(result)[:limit] if result else []
 
+    def prune(self, retain_height: int) -> int:
+        """Delete tx records and postings below retain_height
+        (reference state/txindex/kv Prune, driven by the pruning
+        companion API). Heights sit mid-key in postings, so this is a
+        full scan — it runs from the privileged pruning service, not a
+        hot path."""
+        deletes = []
+        for k, v in self._db.iterate(_PK, _PK + b"\xff" * 32):
+            f = proto.parse_fields(v)
+            if proto.field_int(f, 1, 0) < retain_height:
+                deletes.append(k)
+        for k, _v in self._db.iterate(_POST, _POST + b"\xff" * 8):
+            # key = tag \0 value_hex \0 height8 \0 suffix; tag and the
+            # hex value contain no NULs, the binary height may
+            rest = k[len(_POST):]
+            _tag, _, rest = rest.partition(b"\x00")
+            _val, _, tail = rest.partition(b"\x00")
+            if int.from_bytes(tail[:8], "big") < retain_height:
+                deletes.append(k)
+        with self._lock:
+            if deletes:
+                self._db.write_batch([], deletes)
+        return len(deletes)
+
     def _scan_condition(self, cond) -> set:
         tag = cond.tag.encode()
         out = set()
@@ -104,6 +128,20 @@ class BlockIndexer:
                              + str(v).encode().hex().encode()
                              + b"\x00" + height.to_bytes(8, "big"), b""))
         self._db.write_batch(sets)
+
+    def prune(self, retain_height: int) -> int:
+        """Delete block-event postings below retain_height (reference
+        state/indexer/block/kv Prune)."""
+        deletes = []
+        for k, _v in self._db.iterate(_BLK, _BLK + b"\xff" * 8):
+            rest = k[len(_BLK):]
+            _tag, _, rest = rest.partition(b"\x00")
+            _val, _, tail = rest.partition(b"\x00")
+            if int.from_bytes(tail[:8], "big") < retain_height:
+                deletes.append(k)
+        if deletes:
+            self._db.write_batch([], deletes)
+        return len(deletes)
 
     def search(self, query: Query, limit: int = 100) -> List[int]:
         result: Optional[set] = None

@@ -122,6 +122,13 @@ class Node:
                     from ..abci.kvstore import KVStoreApplication
                     client_creator = local_client_creator(
                         KVStoreApplication())
+                elif target.startswith("grpc://"):
+                    from ..proxy.multi_app_conn import (
+                        remote_grpc_client_creator)
+                    host, port = self._split_addr(
+                        target.removeprefix("grpc://"))
+                    client_creator = remote_grpc_client_creator(host,
+                                                                port)
                 else:
                     from ..proxy.multi_app_conn import (
                         remote_client_creator)
@@ -161,7 +168,9 @@ class Node:
         from ..state.pruner import Pruner
         self.pruner = Pruner(
             self.block_store, self.state_store,
-            interval_s=config.storage.pruning_interval_ms / 1000.0)
+            interval_s=config.storage.pruning_interval_ms / 1000.0,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer)
         self.executor.pruner = self.pruner
         from ..libs.metrics import ConsensusMetrics, Registry
         self.metrics_registry = Registry()
@@ -214,20 +223,39 @@ class Node:
         self.switch.add_reactor(self.statesync_reactor)
 
         # --- RPC (node.go:559 — started first on OnStart) --------------------
+        self.rpc_env = RPCEnvironment(
+            chain_id=self.genesis.chain_id,
+            block_store=self.block_store,
+            state_store=self.state_store, mempool=self.mempool,
+            consensus=self.consensus, event_bus=self.event_bus,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer,
+            app_query=self.app_conns.query, genesis=self.genesis,
+            switch=self.switch,
+            evidence_pool=self.evidence_pool,
+            unsafe=config.rpc.unsafe)
         self.rpc_server: Optional[RPCServer] = None
         if config.rpc.enable:
             host, port = self._split_addr(config.rpc.laddr)
-            self.rpc_server = RPCServer(RPCEnvironment(
-                chain_id=self.genesis.chain_id,
-                block_store=self.block_store,
-                state_store=self.state_store, mempool=self.mempool,
-                consensus=self.consensus, event_bus=self.event_bus,
-                tx_indexer=self.tx_indexer,
-                block_indexer=self.block_indexer,
-                app_query=self.app_conns.query, genesis=self.genesis,
-                switch=self.switch,
-                evidence_pool=self.evidence_pool,
-                unsafe=config.rpc.unsafe), host, port)
+            self.rpc_server = RPCServer(self.rpc_env, host, port)
+
+        # --- companion gRPC services (node.go:805-845) -----------------------
+        self.grpc_services = None
+        self.grpc_privileged = None
+        gc = config.grpc
+        if gc.laddr:
+            from ..rpc.grpc import GRPCServices
+            host, port = self._split_addr(gc.laddr)
+            self.grpc_services = GRPCServices(
+                self.rpc_env, host, port,
+                version_service=gc.version_service,
+                block_service=gc.block_service,
+                block_results_service=gc.block_results_service)
+        if gc.privileged_laddr and gc.pruning_service:
+            from ..rpc.grpc import PrivilegedGRPCServices
+            host, port = self._split_addr(gc.privileged_laddr)
+            self.grpc_privileged = PrivilegedGRPCServices(
+                self.pruner, self.block_store, host, port)
 
     @staticmethod
     def _split_addr(addr: str):
@@ -266,6 +294,12 @@ class Node:
     def start(self) -> None:
         if self.rpc_server is not None:
             self.rpc_server.start()          # RPC first (node.go:559)
+        if self.grpc_services is not None:
+            self.grpc_services.start()
+            self.grpc_addr = self.grpc_services.addr
+        if self.grpc_privileged is not None:
+            self.grpc_privileged.start()
+            self.grpc_priv_addr = self.grpc_privileged.addr
         if self.config.tx_index.indexer != "null":
             # "null" = no indexing (reference state/txindex null sink):
             # the service never subscribes, searches return empty
@@ -463,4 +497,8 @@ class Node:
         self.indexer_service.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if self.grpc_services is not None:
+            self.grpc_services.stop()
+        if self.grpc_privileged is not None:
+            self.grpc_privileged.stop()
         self.app_conns.stop()

@@ -53,6 +53,16 @@ def remote_client_creator(host: str, port: int) -> Callable[[], Application]:
     return create
 
 
+def remote_grpc_client_creator(host: str, port: int
+                               ) -> Callable[[], Application]:
+    """reference proxy.NewRemoteClientCreator with transport=grpc —
+    four independent channels, one per named connection."""
+    def create() -> Application:
+        from ..abci.grpc import GRPCClient
+        return GRPCClient(host, port)
+    return create
+
+
 class AppConns:
     """reference proxy/multi_app_conn.go multiAppConn."""
 

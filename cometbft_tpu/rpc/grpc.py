@@ -1,0 +1,289 @@
+"""Node companion gRPC services (reference rpc/grpc/server: the
+cometbft.services.* v1 surface — VersionService, BlockService,
+BlockResultsService — plus the privileged PruningService on its own
+listener, rpc/grpc/server/privileged).
+
+Method names and shapes follow the reference protos
+(proto/cometbft/services/{version,block,block_results,pruning}/v1);
+bodies use the same node-local JSON codec as the ABCI gRPC flavor
+(abci/grpc.py) — both sides of every service here are in-tree.
+GetLatestHeight is the reference's long-lived server stream: one
+response per committed block until the client goes away.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from .. import ABCI_SEM_VER, BLOCK_PROTOCOL, P2P_PROTOCOL, __version__
+from ..abci.grpc import _de, _ser
+from ..pubsub.events import QUERY_NEW_BLOCK
+from .server import RPCEnvironment, RPCError, Routes
+
+VERSION_SERVICE = "cometbft.services.version.v1.VersionService"
+BLOCK_SERVICE = "cometbft.services.block.v1.BlockService"
+BLOCK_RESULTS_SERVICE = \
+    "cometbft.services.block_results.v1.BlockResultsService"
+PRUNING_SERVICE = "cometbft.services.pruning.v1.PruningService"
+
+# long-lived GetLatestHeight streams each pin a worker thread in grpc's
+# sync server; cap them so unary RPCs always have workers left
+_MAX_STREAMS = 4
+_WORKERS = 8
+
+
+def _unary(fn):
+    """Wrap a dict->dict handler into a grpc unary handler, mapping
+    RPCError/ValueError to INVALID_ARGUMENT and the rest to INTERNAL."""
+    def handle(body: dict, context):
+        try:
+            return fn(body)
+        except (RPCError, ValueError, KeyError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+    return handle
+
+
+class GRPCServices:
+    """The public gRPC listener (reference rpc/grpc/server/server.go
+    Serve — version/block/block-results services behind one port)."""
+
+    def __init__(self, env: RPCEnvironment, host: str = "127.0.0.1",
+                 port: int = 0, version_service: bool = True,
+                 block_service: bool = True,
+                 block_results_service: bool = True):
+        self.env = env
+        self._routes = Routes(env)
+        self._streams = threading.BoundedSemaphore(_MAX_STREAMS)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=_WORKERS,
+                                       thread_name_prefix="grpc-svc"))
+        handlers = []
+        if version_service:
+            handlers.append(grpc.method_handlers_generic_handler(
+                VERSION_SERVICE,
+                {"GetVersion": grpc.unary_unary_rpc_method_handler(
+                    _unary(self._get_version),
+                    request_deserializer=_de, response_serializer=_ser)}))
+        if block_service:
+            handlers.append(grpc.method_handlers_generic_handler(
+                BLOCK_SERVICE,
+                {"GetByHeight": grpc.unary_unary_rpc_method_handler(
+                    _unary(self._get_by_height),
+                    request_deserializer=_de, response_serializer=_ser),
+                 "GetLatestHeight": grpc.unary_stream_rpc_method_handler(
+                    self._get_latest_height,
+                    request_deserializer=_de, response_serializer=_ser)}))
+        if block_results_service:
+            handlers.append(grpc.method_handlers_generic_handler(
+                BLOCK_RESULTS_SERVICE,
+                {"GetBlockResults": grpc.unary_unary_rpc_method_handler(
+                    _unary(self._get_block_results),
+                    request_deserializer=_de, response_serializer=_ser)}))
+        if handlers:
+            self._server.add_generic_rpc_handlers(tuple(handlers))
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise OSError(f"[grpc] laddr {host}:{port} failed to bind")
+        self.addr = (host, bound)
+
+    # --- VersionService ----------------------------------------------------
+
+    def _get_version(self, _body: dict) -> dict:
+        """reference proto GetVersionResponse: node/abci/p2p/block."""
+        return {"node": __version__, "abci": ABCI_SEM_VER,
+                "p2p": P2P_PROTOCOL, "block": BLOCK_PROTOCOL}
+
+    # --- BlockService ------------------------------------------------------
+
+    def _get_by_height(self, body: dict) -> dict:
+        return self._routes.block(body.get("height"))
+
+    def _get_latest_height(self, _body: dict, context):
+        """Long-lived stream of committed heights (reference
+        block_service.proto GetLatestHeight). Terminates when the
+        client disconnects or the node's event bus shuts down."""
+        if self.env.event_bus is None:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "no event bus")
+        if not self._streams.acquire(blocking=False):
+            # each live stream pins a worker thread for its whole life;
+            # past the cap, refuse instead of starving unary RPCs
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          f"too many GetLatestHeight streams "
+                          f"(max {_MAX_STREAMS})")
+        sub_id = f"grpc-latest-height-{uuid.uuid4().hex[:8]}"
+        sub = self.env.event_bus.server.subscribe(
+            sub_id, QUERY_NEW_BLOCK, buffer=64)
+        try:
+            while context.is_active():
+                got = sub.next(timeout=0.25)
+                if got is None:
+                    continue
+                event, _attrs = got
+                block, _res = event.data
+                yield {"height": block.header.height}
+        finally:
+            self.env.event_bus.server.unsubscribe_all(sub_id)
+            self._streams.release()
+
+    # --- BlockResultsService ----------------------------------------------
+
+    def _get_block_results(self, body: dict) -> dict:
+        return self._routes.block_results(body.get("height"))
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+class PrivilegedGRPCServices:
+    """The privileged listener (reference rpc/grpc/server/privileged):
+    operator-only pruning control, deliberately on a separate port so
+    the public one can be exposed without handing out prune rights."""
+
+    def __init__(self, pruner, block_store, host: str = "127.0.0.1",
+                 port: int = 0, pruning_service: bool = True):
+        self.pruner = pruner
+        self.block_store = block_store
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=2,
+                                       thread_name_prefix="grpc-priv"))
+        if pruning_service:
+            methods = {
+                "SetBlockRetainHeight": self._set_block,
+                "GetBlockRetainHeight": self._get_block,
+                "SetBlockResultsRetainHeight": self._set_results,
+                "GetBlockResultsRetainHeight": self._get_results,
+                "SetTxIndexerRetainHeight": self._set_tx_index,
+                "GetTxIndexerRetainHeight": self._get_tx_index,
+                "SetBlockIndexerRetainHeight": self._set_block_index,
+                "GetBlockIndexerRetainHeight": self._get_block_index,
+            }
+            self._server.add_generic_rpc_handlers(
+                (grpc.method_handlers_generic_handler(
+                    PRUNING_SERVICE,
+                    {name: grpc.unary_unary_rpc_method_handler(
+                        _unary(fn), request_deserializer=_de,
+                        response_serializer=_ser)
+                     for name, fn in methods.items()}),))
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise OSError(
+                f"[grpc] privileged_laddr {host}:{port} failed to bind")
+        self.addr = (host, bound)
+
+    def _height(self, body: dict) -> int:
+        h = int(body.get("height", 0))
+        if h <= 0:
+            raise ValueError("retain height must be positive")
+        if h > self.block_store.height():
+            raise ValueError(
+                f"retain height {h} is beyond the store tip "
+                f"{self.block_store.height()}")
+        return h
+
+    def _set_block(self, body: dict) -> dict:
+        self.pruner.set_companion_block_retain_height(self._height(body))
+        return {}
+
+    def _get_block(self, _body: dict) -> dict:
+        rh = self.pruner.retain_heights()
+        return {"app_retain_height": rh["app_retain_height"],
+                "pruning_service_retain_height":
+                    rh["pruning_service_block_retain_height"]}
+
+    def _set_results(self, body: dict) -> dict:
+        self.pruner.set_block_results_retain_height(self._height(body))
+        return {}
+
+    def _get_results(self, _body: dict) -> dict:
+        return {"pruning_service_retain_height":
+                self.pruner.retain_heights()
+                ["pruning_service_block_results_retain_height"]}
+
+    def _set_tx_index(self, body: dict) -> dict:
+        self.pruner.set_tx_indexer_retain_height(self._height(body))
+        return {}
+
+    def _get_tx_index(self, _body: dict) -> dict:
+        return {"height": self.pruner.retain_heights()
+                ["pruning_service_tx_indexer_retain_height"]}
+
+    def _set_block_index(self, body: dict) -> dict:
+        self.pruner.set_block_indexer_retain_height(self._height(body))
+        return {}
+
+    def _get_block_index(self, _body: dict) -> dict:
+        return {"height": self.pruner.retain_heights()
+                ["pruning_service_block_indexer_retain_height"]}
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+class GRPCServiceClient:
+    """Client for the public + privileged services (reference
+    rpc/grpc/client Client / PrivilegedClient)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._timeout = timeout_s
+        u = self._channel.unary_unary
+        self._get_version = u(f"/{VERSION_SERVICE}/GetVersion",
+                              request_serializer=_ser,
+                              response_deserializer=_de)
+        self._get_by_height = u(f"/{BLOCK_SERVICE}/GetByHeight",
+                                request_serializer=_ser,
+                                response_deserializer=_de)
+        self._latest_height = self._channel.unary_stream(
+            f"/{BLOCK_SERVICE}/GetLatestHeight",
+            request_serializer=_ser, response_deserializer=_de)
+        self._block_results = u(
+            f"/{BLOCK_RESULTS_SERVICE}/GetBlockResults",
+            request_serializer=_ser, response_deserializer=_de)
+        self._pruning = {
+            name: u(f"/{PRUNING_SERVICE}/{name}",
+                    request_serializer=_ser, response_deserializer=_de)
+            for name in (
+                "SetBlockRetainHeight", "GetBlockRetainHeight",
+                "SetBlockResultsRetainHeight",
+                "GetBlockResultsRetainHeight",
+                "SetTxIndexerRetainHeight", "GetTxIndexerRetainHeight",
+                "SetBlockIndexerRetainHeight",
+                "GetBlockIndexerRetainHeight")}
+
+    def get_version(self) -> dict:
+        return self._get_version({}, timeout=self._timeout)
+
+    def get_block_by_height(self, height: Optional[int] = None) -> dict:
+        body = {} if height is None else {"height": height}
+        return self._get_by_height(body, timeout=self._timeout)
+
+    def get_latest_height_stream(self):
+        """Yields {"height": h} per commit; iterate and break (or
+        cancel) when done."""
+        return self._latest_height({})
+
+    def get_block_results(self, height: Optional[int] = None) -> dict:
+        body = {} if height is None else {"height": height}
+        return self._block_results(body, timeout=self._timeout)
+
+    def pruning(self, method: str, **body) -> dict:
+        return self._pruning[method](body, timeout=self._timeout)
+
+    def close(self) -> None:
+        self._channel.close()

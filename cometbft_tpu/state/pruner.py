@@ -1,6 +1,13 @@
 """Background pruning service honoring the app's retain height
 (reference state/pruner.go — the Commit response's retain_height,
-state/execution.go:315).
+state/execution.go:315) and the data companion's retain heights set
+through the privileged gRPC PruningService (reference
+rpc/grpc/server/privileged, proto/cometbft/services/pruning/v1).
+
+Block data is pruned to the LOWER of the app's and the companion's
+retain heights (each treated as "no opinion" while 0, matching the
+reference pruner's findMinRetainHeight). Block results, tx-index and
+block-index retain heights are companion-only.
 """
 
 from __future__ import annotations
@@ -9,15 +16,28 @@ import threading
 from typing import Optional
 
 
+def _effective(*heights: int) -> int:
+    """min of the set (>0) opinions; 0 = nobody asked to prune."""
+    set_ = [h for h in heights if h > 0]
+    return min(set_) if set_ else 0
+
+
 class Pruner:
     """Prunes block data below the app-requested retain height."""
 
     def __init__(self, block_store, state_store=None,
-                 interval_s: float = 10.0):
+                 interval_s: float = 10.0, tx_indexer=None,
+                 block_indexer=None):
         self.block_store = block_store
         self.state_store = state_store
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
         self.interval_s = interval_s
-        self._retain = 0
+        self._retain = 0                 # app (ResponseCommit)
+        self._companion_retain = 0       # PruningService block retain
+        self._results_retain = 0         # PruningService block results
+        self._tx_index_retain = 0        # PruningService tx indexer
+        self._block_index_retain = 0     # PruningService block indexer
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -28,14 +48,55 @@ class Pruner:
             self._retain = height
             self._wake.set()
 
+    # --- companion (privileged PruningService) setters ---------------------
+
+    def set_companion_block_retain_height(self, height: int) -> None:
+        self._companion_retain = height
+        self._wake.set()
+
+    def set_block_results_retain_height(self, height: int) -> None:
+        self._results_retain = height
+        self._wake.set()
+
+    def set_tx_indexer_retain_height(self, height: int) -> None:
+        self._tx_index_retain = height
+        self._wake.set()
+
+    def set_block_indexer_retain_height(self, height: int) -> None:
+        self._block_index_retain = height
+        self._wake.set()
+
+    def retain_heights(self) -> dict:
+        """Snapshot for the Get* pruning APIs."""
+        return {
+            "app_retain_height": self._retain,
+            "pruning_service_block_retain_height": self._companion_retain,
+            "pruning_service_block_results_retain_height":
+                self._results_retain,
+            "pruning_service_tx_indexer_retain_height":
+                self._tx_index_retain,
+            "pruning_service_block_indexer_retain_height":
+                self._block_index_retain,
+        }
+
     def prune_now(self) -> int:
-        retain = self._retain
-        if retain <= 0:
-            return 0
-        pruned = self.block_store.prune_blocks(
-            min(retain, self.block_store.height()))
-        if self.state_store is not None:
-            self.state_store.prune(retain)
+        retain = _effective(self._retain, self._companion_retain)
+        pruned = 0
+        if retain > 0:
+            pruned = self.block_store.prune_blocks(
+                min(retain, self.block_store.height()))
+            if self.state_store is not None:
+                self.state_store.prune(retain)
+        if self._results_retain > 0 and self.state_store is not None:
+            # never drop the latest response: crash recovery replays
+            # from it (reference pruner.go keeps the tip)
+            self.state_store.prune_abci_responses(
+                min(self._results_retain, self.block_store.height()))
+        if self._tx_index_retain > 0 and self.tx_indexer is not None:
+            self.tx_indexer.prune(self._tx_index_retain)
+        if self._block_index_retain > 0 and \
+                self.block_indexer is not None:
+            self.block_indexer.prune(self._block_index_retain)
         return pruned
 
     def start(self) -> None:

@@ -226,6 +226,18 @@ class StateStore:
             self._db.write_batch([], deletes)
         return len(deletes)
 
+    def prune_abci_responses(self, retain_height: int) -> int:
+        """Delete only FinalizeBlock responses below retain_height
+        (reference state/store.go PruneABCIResponses — driven by the
+        data companion's block-results retain height, independent of
+        block/state pruning)."""
+        prefix = b"abci:"
+        end = prefix + retain_height.to_bytes(8, "big")
+        deletes = [k for k, _v in self._db.iterate(prefix, end)]
+        if deletes:
+            self._db.write_batch([], deletes)
+        return len(deletes)
+
 
 def _valset_to_json(vs: ValidatorSet) -> bytes:
     prop = vs.get_proposer()
