@@ -140,11 +140,20 @@ def _gen_signatures(n, n_validators=200, msg_len=122, seed=7):
 def measure(batch, iters):
     """Time the RLC kernel on the already-initialized default backend.
 
-    Returns (sigs_per_sec, compile_secs)."""
+    BENCH_KERNEL=xla|pallas picks the point-stage implementation;
+    default: pallas on TPU backends, xla elsewhere (the pallas mosaic
+    kernels target the chip). Returns (sigs_per_sec, compile_secs)."""
     import numpy as np
     import jax
+    from cometbft_tpu.ops import ed25519 as e5
     from cometbft_tpu.ops.ed25519 import (
-        verify_rlc_kernel, prepare_batch, make_rlc_coefficients)
+        prepare_batch, make_rlc_coefficients)
+
+    which = os.environ.get("BENCH_KERNEL") or \
+        ("pallas" if e5.use_pallas_rlc() else "xla")
+    kernel = (e5.verify_rlc_kernel_pallas if which == "pallas"
+              else e5.verify_rlc_kernel)
+    _log(f"kernel: {which}")
 
     _log(f"generating {batch} signatures (200-validator set)...")
     pubs, msgs, sigs = _gen_signatures(batch)
@@ -160,7 +169,7 @@ def measure(batch, iters):
          "tens of seconds; persistent cache is on for TPU)...")
     tc = time.monotonic()
     z = make_rlc_coefficients(batch)
-    bok, sok = verify_rlc_kernel(pub, sig, hb, hn, z)  # compile + warm
+    bok, sok = kernel(pub, sig, hb, hn, z)  # compile + warm
     compile_secs = time.monotonic() - tc
     assert bool(bok) and np.asarray(sok).all(), "warmup verification failed"
     _log(f"warm in {compile_secs:.1f}s; timing {iters} iterations...")
@@ -168,7 +177,7 @@ def measure(batch, iters):
     t0 = time.perf_counter()
     for i in range(iters):
         z = make_rlc_coefficients(batch)
-        bok, out = verify_rlc_kernel(pub, sig, hb, hn, z)
+        bok, out = kernel(pub, sig, hb, hn, z)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     assert bool(bok)
@@ -193,6 +202,9 @@ def _measure_mode(batch: int, iters: int) -> int:
         "unit": "sigs/s",
         "vs_baseline": round(sigs_per_sec / BASELINE_SIGS_PER_SEC, 3),
         "batch": batch,
+        # which point-stage implementation produced the number — the
+        # xla fallback must be distinguishable from a pallas result
+        "kernel": os.environ.get("BENCH_KERNEL") or "auto",
     }
     if dev.platform == "cpu":
         rec["backend"] = "cpu"
@@ -229,26 +241,48 @@ def main():
     for b in (batch, batch // 4, 1024, 256, 64):
         if b >= 1 and b not in attempts:
             attempts.append(b)
+    # kernel fallback: if the (default) pallas point-stage fails to
+    # compile/run on this backend, retry the same batch with the pure
+    # XLA kernel before shrinking the batch
+    if os.environ.get("BENCH_KERNEL"):
+        kernels = [os.environ["BENCH_KERNEL"]]
+    elif platform == "cpu":
+        kernels = ["xla"]
+    else:
+        kernels = ["pallas", "xla"]
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_TOTAL_TIMEOUT", "4500"))
     for b in attempts:
-        _log(f"measuring batch={b} in a subprocess "
-             f"(timeout {measure_timeout:.0f}s)...")
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--measure", str(b), str(iters)],
-                capture_output=True, text=True, timeout=measure_timeout)
-        except subprocess.TimeoutExpired:
-            _log(f"measure[{b}] timed out; not retrying larger work")
-            return 1
-        sys.stderr.write(r.stderr)
-        line = next((ln for ln in r.stdout.splitlines()
-                     if ln.startswith("{")), None)
-        if r.returncode == 0 and line:
-            print(line, flush=True)
-            return 0
-        _log(f"measure[{b}] failed rc={r.returncode} "
-             f"(signal={-r.returncode if r.returncode < 0 else 'none'});"
-             f" retrying smaller batch")
+        for which in kernels:
+            if time.monotonic() > deadline:
+                _log("total bench budget exhausted")
+                return 1
+            _log(f"measuring batch={b} kernel={which} in a subprocess "
+                 f"(timeout {measure_timeout:.0f}s)...")
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--measure", str(b), str(iters)],
+                    env=dict(os.environ, BENCH_KERNEL=which),
+                    capture_output=True, text=True,
+                    timeout=measure_timeout)
+            except subprocess.TimeoutExpired:
+                # a hung pallas compile must not kill the run — the
+                # XLA kernel (or a smaller batch) may still produce
+                # the number
+                _log(f"measure[{b},{which}] timed out; trying the "
+                     f"next kernel/batch")
+                continue
+            sys.stderr.write(r.stderr)
+            line = next((ln for ln in r.stdout.splitlines()
+                         if ln.startswith("{")), None)
+            if r.returncode == 0 and line:
+                print(line, flush=True)
+                return 0
+            _log(f"measure[{b},{which}] failed rc={r.returncode} "
+                 f"(signal="
+                 f"{-r.returncode if r.returncode < 0 else 'none'}); "
+                 f"retrying")
     _log("all batch sizes failed")
     return 1
 

@@ -93,6 +93,14 @@ class VoteMessage:
     vote: Vote
 
 
+@dataclass(frozen=True)
+class _BroadcastMarker:
+    """Internal-queue entry: gossip `msg` once the local deliveries
+    queued ahead of it have been processed (see
+    _broadcast_after_processing)."""
+    msg: "Message"
+
+
 Message = Union[ProposalMessage, BlockPartMessage, VoteMessage, TimeoutInfo]
 
 
@@ -161,6 +169,11 @@ class ConsensusState:
         # peer from ballooning memory.
         self._pending: List[tuple] = []
         self._pending_cap = 10000
+        # own-message re-entry queue (reference internalMsgQueue) — see
+        # handle_msg
+        from collections import deque
+        self._internal_q: "deque[tuple]" = deque()
+        self._in_handle = False
 
         self._priv_pubkey = (priv_validator.get_pub_key()
                              if priv_validator else None)
@@ -215,9 +228,51 @@ class ConsensusState:
     # --- message dispatch ----------------------------------------------------
 
     def handle_msg(self, msg, peer_id: str = "") -> None:
-        """reference state.go:869-926 handleMsg + :988 handleTimeout."""
+        """reference state.go:869-926 handleMsg + :988 handleTimeout.
+
+        Reentrant calls (the state machine delivering its OWN proposal,
+        parts, and votes from inside a handler — the reference's
+        internalMsgQueue) are queued and drained iteratively by the
+        OUTERMOST call. Without this, a node that never waits (single
+        validator + skip_timeout_commit) chains height N's commit into
+        height N+1's proposal on the same Python stack, ~30 frames per
+        height, and the consensus thread dies of RecursionError after
+        ~35 uninterrupted heights."""
+        self._internal_q.append((msg, peer_id))
+        if self._in_handle:
+            return
+        self._in_handle = True
+        try:
+            # the drain must watch _stop: a solo validator with
+            # timeout_commit=0 chains commit -> next proposal with no
+            # waiting, so the queue NEVER empties — without this check
+            # one outer handle_msg runs the chain forever and stop()
+            # can neither join the thread nor reclaim the core
+            while self._internal_q and not self._stop.is_set():
+                m, pid = self._internal_q.popleft()
+                self._handle_one(m, pid)
+        finally:
+            self._in_handle = False
+
+    def _broadcast_after_processing(self, msg) -> None:
+        """Gossip an own message AFTER the local delivery queued ahead
+        of it has been processed — broadcasting first would let a vote
+        leave the node before its WAL fsync (crash window: peers hold a
+        precommit our replay doesn't know; re-signing with a fresh
+        timestamp then trips the privval CheckHRS guard)."""
+        if self._replaying:
+            return
+        if self._in_handle:
+            self._internal_q.append((_BroadcastMarker(msg), ""))
+        else:
+            self.broadcast(msg)  # delivery already drained
+
+    def _handle_one(self, msg, peer_id: str = "") -> None:
         if isinstance(msg, tuple):
             msg, peer_id = msg
+        if isinstance(msg, _BroadcastMarker):
+            self.broadcast(msg.msg)
+            return
         if isinstance(msg, TimeoutInfo):
             self._handle_timeout(msg)
             return
@@ -401,14 +456,14 @@ class ConsensusState:
             self.priv_validator.sign_proposal(self.chain_id, proposal)
         except DoubleSignError:
             return
-        # deliver to self through the internal queue path, then gossip
+        # deliver to self through the internal queue path; gossip is
+        # queued BEHIND the local delivery (WAL-then-wire ordering)
         self.handle_msg(ProposalMessage(proposal))
+        self._broadcast_after_processing(ProposalMessage(proposal))
         for part in parts.parts:
             self.handle_msg(BlockPartMessage(height, round_, part))
-        if not self._replaying:
-            self.broadcast(ProposalMessage(proposal))
-            for part in parts.parts:
-                self.broadcast(BlockPartMessage(height, round_, part))
+            self._broadcast_after_processing(
+                BlockPartMessage(height, round_, part))
 
     def _last_commit_for_proposal(self, height: int) -> Optional[Commit]:
         if height == self.state.initial_height:
@@ -787,8 +842,7 @@ class ConsensusState:
         except DoubleSignError:
             return  # never sign conflicting votes; stay silent
         self.handle_msg(VoteMessage(vote))
-        if not self._replaying:
-            self.broadcast(VoteMessage(vote))
+        self._broadcast_after_processing(VoteMessage(vote))
 
     def _try_add_vote(self, vote: Vote, peer_id: str) -> None:
         """reference state.go:2256-2339 tryAddVote: conflicting votes

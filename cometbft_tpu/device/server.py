@@ -41,9 +41,8 @@ class DeviceServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  bucket: int = 1024, max_msg_len: int = 256,
                  flush_us: int = 200):
-        import jax
-        first = (jax.config.jax_platforms or "").split(",")[0]
-        if first in ("", "cpu") and bucket > 64:
+        from ..libs.jax_cache import is_device_platform
+        if not is_device_platform() and bucket > 64:
             # XLA:CPU crashes (compiler stack overflow) building the
             # RLC kernel at batch >=256 and takes minutes at 64+
             # (docs/PERF.md); a CPU-backed dev server clamps rather

@@ -30,6 +30,16 @@ def _posting_key(tag: bytes, value: bytes, height: int,
             + height.to_bytes(8, "big") + b"\x00" + suffix)
 
 
+def _posting_height(key: bytes, prefix: bytes) -> int:
+    """Height embedded in a posting key: tag \\0 value_hex \\0 height8
+    \\0 suffix after `prefix`. Tag and the hex value contain no NULs;
+    the 8-byte big-endian height may, so it is parsed positionally."""
+    rest = key[len(prefix):]
+    _tag, _, rest = rest.partition(b"\x00")
+    _val, _, tail = rest.partition(b"\x00")
+    return int.from_bytes(tail[:8], "big")
+
+
 class TxIndexer:
     """reference state/txindex/kv/kv.go TxIndex."""
 
@@ -85,12 +95,7 @@ class TxIndexer:
             if proto.field_int(f, 1, 0) < retain_height:
                 deletes.append(k)
         for k, _v in self._db.iterate(_POST, _POST + b"\xff" * 8):
-            # key = tag \0 value_hex \0 height8 \0 suffix; tag and the
-            # hex value contain no NULs, the binary height may
-            rest = k[len(_POST):]
-            _tag, _, rest = rest.partition(b"\x00")
-            _val, _, tail = rest.partition(b"\x00")
-            if int.from_bytes(tail[:8], "big") < retain_height:
+            if _posting_height(k, _POST) < retain_height:
                 deletes.append(k)
         with self._lock:
             if deletes:
@@ -134,10 +139,7 @@ class BlockIndexer:
         state/indexer/block/kv Prune)."""
         deletes = []
         for k, _v in self._db.iterate(_BLK, _BLK + b"\xff" * 8):
-            rest = k[len(_BLK):]
-            _tag, _, rest = rest.partition(b"\x00")
-            _val, _, tail = rest.partition(b"\x00")
-            if int.from_bytes(tail[:8], "big") < retain_height:
+            if _posting_height(k, _BLK) < retain_height:
                 deletes.append(k)
         if deletes:
             self._db.write_batch([], deletes)

@@ -15,6 +15,25 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def first_configured_platform() -> str:
+    """First entry of jax.config.jax_platforms WITHOUT initializing a
+    backend ("" when undetermined). The shared device-vs-cpu sniff:
+    jax.devices() can hang forever on a wedged TPU tunnel, so every
+    caller that merely needs to know "is a real device configured?"
+    must read the config, never touch the backend."""
+    try:
+        import jax
+        return (jax.config.jax_platforms or "").split(",")[0]
+    except Exception:  # noqa: BLE001 — undetermined == no device
+        return ""
+
+
+def is_device_platform() -> bool:
+    """True when the first configured platform is a real accelerator
+    (not cpu / undetermined)."""
+    return first_configured_platform() not in ("", "cpu")
+
+
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     import jax
     # the ambient TPU-tunnel setup pins jax_platforms programmatically

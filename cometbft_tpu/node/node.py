@@ -341,12 +341,8 @@ class Node:
         device path; cpu/undetermined stays native (jitting the RLC
         kernel on XLA:CPU costs minutes per bucket and crashes the
         compiler outright at batch >=256 — docs/PERF.md)."""
-        try:
-            import jax
-            first = (jax.config.jax_platforms or "").split(",")[0]
-            return 256 if first not in ("", "cpu") else 0
-        except Exception:  # noqa: BLE001
-            return 0
+        from ..libs.jax_cache import is_device_platform
+        return 256 if is_device_platform() else 0
 
     def _prewarm_kernels(self) -> None:
         if self._device_batch_size() <= 0:

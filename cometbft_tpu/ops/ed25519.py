@@ -146,6 +146,82 @@ def verify_rlc_core(pub: jnp.ndarray, sig: jnp.ndarray,
 verify_rlc_kernel = jax.jit(verify_rlc_core)
 
 
+def verify_rlc_core_pallas(pub: jnp.ndarray, sig: jnp.ndarray,
+                           hblocks: jnp.ndarray, hnblocks: jnp.ndarray,
+                           z: jnp.ndarray,
+                           interpret: bool = False
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """`verify_rlc_core` with the dominant point stage (window tables +
+    digit selects + lane trees) in a fused Pallas kernel
+    (ops/pallas_verify.rlc_window_sums) that keeps every point
+    intermediate in VMEM. Same equation, same verdict semantics; the
+    XLA share is reduced to decompression, scalar work, a (96, G*TAIL)
+    fold, the shared-base [S]B windows, and the Horner.
+
+    Motivation: on the chip the XLA-composed point ops run 40-150x
+    below their fe_mul content (docs/PERF.md) — past a few hundred
+    HLOs the fuser stops fusing and intermediates round-trip HBM.
+    """
+    from .pallas_verify import (A_WINDOWS, TAIL, pack_point,
+                                rlc_window_sums)
+
+    sig_b = jnp.moveaxis(sig, -1, 0)                   # (64, N)
+    r_enc, s_enc = sig_b[:32], sig_b[32:]
+    s = bytes_to_limbs(s_enc.astype(jnp.int32))        # (16, N)
+    s_ok = sc_lt_l(s)
+
+    a_pt, a_ok = ed.pt_decompress(jnp.moveaxis(pub, -1, 0), zip215=True)
+    r_pt, r_ok = ed.pt_decompress(r_enc, zip215=True)
+
+    digest = jnp.moveaxis(sha512_blocks(hblocks, hnblocks), -1, 0)
+    k = sc_reduce_wide(bytes_to_limbs(digest.astype(jnp.int32)))
+
+    struct_ok = s_ok & a_ok & r_ok                     # (N,)
+    zl = jnp.moveaxis(z, -1, 0)                        # (8, N)
+    zl = zl * struct_ok[None].astype(zl.dtype)
+
+    s_sum = sc_dot_mod_l(zl, s)                        # (16,)
+    z16 = jnp.concatenate([zl, jnp.zeros_like(zl)], axis=0)
+    t = sc_mul(z16, k)                                 # (16, N)
+
+    # fused point stage: per-(tile, window) partial sums of -A and -R
+    out = rlc_window_sums(
+        pack_point(ed.pt_neg(a_pt)), pack_point(ed.pt_neg(r_pt)),
+        sc_nibbles(t), sc_nibbles(z16)[:ZWIN], interpret=interpret)
+    g = out.shape[0]
+    # (G, 96, 4, 16, TAIL) -> coords (16, 96, G*TAIL), then fold lanes
+    folded = jnp.transpose(out, (2, 3, 1, 0, 4)).reshape(
+        4, 16, out.shape[1], g * TAIL)
+    wsum = ed.pt_tree_sum(tuple(folded[i] for i in range(4)))
+    w_a = tuple(c[:, :A_WINDOWS] for c in wsum)        # (16, 64)
+    w_r = tuple(c[:, A_WINDOWS:] for c in wsum)        # (16, 32)
+    lo = ed.pt_add(tuple(c[:, :ZWIN] for c in w_a), w_r)
+    w = tuple(jnp.concatenate([cl, ca[:, ZWIN:]], axis=1)
+              for cl, ca in zip(lo, w_a))
+
+    b_tab = jnp.asarray(ed.small_base_table())
+    w = ed.pt_add(w, ed._lookup_shared(b_tab, sc_nibbles(s_sum)))
+
+    acc = ed.horner_windows(w)
+    acc = ed.pt_double(ed.pt_double(ed.pt_double(acc)))
+    return ed.pt_is_identity(acc), struct_ok
+
+
+verify_rlc_kernel_pallas = jax.jit(verify_rlc_core_pallas,
+                                   static_argnames=("interpret",))
+
+
+def use_pallas_rlc() -> bool:
+    """Pallas point-stage on real TPU backends; XLA path on CPU (the
+    mosaic kernels target the chip; interpret mode is for tests)."""
+    import os
+    env = os.environ.get("COMETBFT_TPU_PALLAS")
+    if env is not None:
+        return env == "1"
+    from ..libs.jax_cache import is_device_platform
+    return is_device_platform()
+
+
 def make_rlc_coefficients(n: int, rng=None) -> np.ndarray:
     """(n, 8) int32 16-bit limbs of 128-bit random coefficients.
 
@@ -249,7 +325,7 @@ def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
         out = None
         if rlc and zip215:
             z = make_rlc_coefficients(batch_size)
-            batch_ok, struct_ok = verify_rlc_kernel(pub_a, sig_a, hb, hn, z)
+            batch_ok, struct_ok = _rlc_dispatch(pub_a, sig_a, hb, hn, z)
             if bool(batch_ok):
                 out = np.asarray(struct_ok)
         if out is None:  # attribution fallback / strict mode
@@ -257,6 +333,25 @@ def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
                                            zip215=zip215))
         outs.append(out[:hi - lo] & ok_mask[:hi - lo])
     return np.concatenate(outs)
+
+
+_pallas_broken = False
+
+
+def _rlc_dispatch(pub_a, sig_a, hb, hn, z):
+    """RLC verify via the pallas point-stage on device platforms,
+    degrading PERMANENTLY to the proven XLA kernel on any pallas
+    failure (mosaic compile/runtime errors must not crash blocksync,
+    and a failing compile must not be re-paid per batch)."""
+    global _pallas_broken
+    if use_pallas_rlc() and not _pallas_broken:
+        try:
+            return verify_rlc_kernel_pallas(pub_a, sig_a, hb, hn, z)
+        except Exception:  # noqa: BLE001
+            _pallas_broken = True
+            import traceback
+            traceback.print_exc()
+    return verify_rlc_kernel(pub_a, sig_a, hb, hn, z)
 
 
 def prewarm_verify_kernels(batch_size: int = 4096,
@@ -276,7 +371,9 @@ def prewarm_verify_kernels(batch_size: int = 4096,
     pub_a, sig_a, hb, hn, _ = prepare_batch([pub], [msg], [sig],
                                             batch_size, msg_cap)
     z = make_rlc_coefficients(batch_size)
-    verify_rlc_kernel(pub_a, sig_a, hb, hn, z)
+    # warm the kernel the live path will actually dispatch to (pallas
+    # on device platforms, with its own sticky XLA degradation)
+    _rlc_dispatch(pub_a, sig_a, hb, hn, z)
     pub_a, sig_a, hb, hn, _ = prepare_batch([pub], [msg], [bad],
                                             batch_size, msg_cap)
     verify_kernel(pub_a, sig_a, hb, hn, zip215=True)

@@ -1,0 +1,274 @@
+"""Pallas TPU kernels for the RLC batch-verify point pipeline.
+
+Why these exist: the XLA-composed point ops run 40-150x slower on the
+chip than their fe_mul content (docs/PERF.md per-stage TPU profile —
+fe_mul 1.8us at N=8192 vs pt_add 941us): past a few hundred HLOs the
+fuser stops fusing and every field-op intermediate round-trips HBM. A
+Pallas kernel holds a lane-tile of the whole pipeline in VMEM (~16MB
+per core), so the only HBM traffic is the tile in and the window sums
+out.
+
+Layout contract matches ops/field.py: limb axis leading, batch (lanes)
+minor. A point here is a single (4, 16, T) int32 array (coord, limb,
+lane) rather than the 4-tuple, so one ref covers it.
+
+Kernels:
+- `pt_add_tiled`: standalone complete addition over lane tiles (the
+  A/B de-risk kernel; same math as edwards.pt_add).
+- `rlc_window_sums`: the fused hot stage of `verify_rlc_core` — per
+  lane-tile, build the 16-entry window tables of -A and -R in VMEM,
+  select per-window entries by scalar digits (compare-accumulate), and
+  tree-reduce across the tile's lanes; emits per-tile per-window
+  partial sums that a tiny XLA epilogue folds and Horners. Replaces
+  the `window_table` + `lookup_windows` + `pt_tree_sum` sequence
+  (215ms of the 192ms/8192-sig RLC iteration on the chip).
+
+CPU tests run the same kernels with interpret=True (tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .field import MASK, LIMB_BITS, FOUR_P_LIMBS, bc
+
+# lanes per grid program. 512 int32 lanes x (2 tables of 16 entries x
+# 4 coords x 16 limbs) = 4MB of table scratch, well under the ~16MB
+# VMEM budget including pt_add temporaries. Env-tunable so a VMEM
+# overflow on some chip generation degrades to a smaller tile instead
+# of a dead kernel.
+import os as _os
+TILE = int(_os.environ.get("COMETBFT_TPU_PALLAS_TILE", "512"))
+
+A_WINDOWS = 64   # radix-16 digits of t_i = z_i * k_i (256-bit)
+R_WINDOWS = 32   # radix-16 digits of the 128-bit z_i
+N_WINDOWS = A_WINDOWS + R_WINDOWS
+TAIL = 8         # lanes left unreduced per (tile, window) — folded by
+#                  the XLA epilogue; keeps the in-kernel tree off the
+#                  worst sub-128-lane shapes
+
+
+# --- field/point helpers on (16, T) arrays, traced INSIDE kernels ---------
+# These mirror ops/field.py (same bounds proofs) but avoid the per-row
+# list/stack pattern: inside a Pallas kernel everything is VMEM-resident
+# so op count, not materialization, is what matters.
+
+def _carry(x: jnp.ndarray) -> jnp.ndarray:
+    """fe_carry on (16, T): limbs [0, 2^27) -> strictly [0, 2^16).
+    Same structure/proof as field.fe_carry (ripple, fold 38, ripple,
+    2-limb mini-cascade)."""
+    c = jnp.zeros_like(x[0])
+    rows = []
+    for i in range(16):
+        v = x[i] + c
+        rows.append(v & MASK)
+        c = v >> LIMB_BITS
+    rows[0] = rows[0] + 38 * c
+    c = jnp.zeros_like(rows[0])
+    for i in range(16):
+        v = rows[i] + c
+        rows[i] = v & MASK
+        c = v >> LIMB_BITS
+    t0 = rows[0] + 38 * c
+    rows[0] = t0 & MASK
+    rows[1] = rows[1] + (t0 >> LIMB_BITS)
+    return jnp.stack(rows)
+
+
+def _mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """fe_mul on (16, T) with the same exactness bounds as
+    field.spread_mul (strict 16-bit limbs in, one uint32 outer product,
+    lo/hi split, schoolbook shift-add, fold 2^256=38, carry)."""
+    au = a.astype(jnp.uint32)
+    bu = b.astype(jnp.uint32)
+    p = au[:, None] * bu[None]                     # (16, 16, T) exact
+    lo = (p & MASK).astype(jnp.int32)
+    hi = (p >> LIMB_BITS).astype(jnp.int32)
+    acc = [jnp.zeros_like(a[0]) for _ in range(32)]
+    for i in range(16):
+        for j in range(16):
+            acc[i + j] = acc[i + j] + lo[i, j]
+            acc[i + j + 1] = acc[i + j + 1] + hi[i, j]
+    folded = [acc[k] + 38 * acc[k + 16] for k in range(16)]
+    return _carry(jnp.stack(folded))
+
+
+# Pallas kernels may not close over constant arrays — the two field
+# constants ride in as a (2, 16) input: row 0 = 4p, row 1 = 2d.
+def _consts_array() -> jnp.ndarray:
+    from .edwards import TWO_D_LIMBS
+    import numpy as np
+    return jnp.asarray(np.stack([FOUR_P_LIMBS, TWO_D_LIMBS]),
+                       dtype=jnp.int32)
+
+
+def _add(a, b):
+    return _carry(a + b)
+
+
+def _sub(a, b, four_p):
+    return _carry(a + four_p - b)
+
+
+def _pt_add(p: jnp.ndarray, q: jnp.ndarray, four_p, two_d) -> jnp.ndarray:
+    """add-2008-hwcd-3 on (4, 16, T) packed points (same formula as
+    edwards.pt_add). four_p/two_d: (16, 1) broadcastable constants."""
+    x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
+    x2, y2, z2, t2 = q[0], q[1], q[2], q[3]
+    a = _mul(_sub(y1, x1, four_p), _sub(y2, x2, four_p))
+    b = _mul(_add(y1, x1), _add(y2, x2))
+    c = _mul(_mul(t1, two_d), t2)
+    d = _carry(2 * _mul(z1, z2))
+    e = _sub(b, a, four_p)
+    f = _sub(d, c, four_p)
+    g = _add(d, c)
+    h = _add(b, a)
+    return jnp.stack([_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h)])
+
+
+def _pt_identity(t: int) -> jnp.ndarray:
+    z = jnp.zeros((16, t), dtype=jnp.int32)
+    one = z.at[0].set(1)
+    return jnp.stack([z, one, one, z])
+
+
+# --- kernel 1: standalone tiled pt_add (A/B de-risk) ----------------------
+
+def _pt_add_kernel(c_ref, p_ref, q_ref, o_ref):
+    four_p, two_d = c_ref[0][:, None], c_ref[1][:, None]
+    o_ref[:] = _pt_add(p_ref[:], q_ref[:], four_p, two_d)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pt_add_tiled(p: jnp.ndarray, q: jnp.ndarray,
+                 interpret: bool = False) -> jnp.ndarray:
+    """Complete addition of (4, 16, N) packed points, N % TILE == 0."""
+    n = p.shape[-1]
+    grid = (n // TILE,)
+    spec = pl.BlockSpec((4, 16, TILE), lambda i: (0, 0, i),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _pt_add_kernel,
+        out_shape=jax.ShapeDtypeStruct(p.shape, jnp.int32),
+        grid=grid,
+        in_specs=[pl.BlockSpec((2, 16), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  spec, spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(_consts_array(), p, q)
+
+
+# --- kernel 2: fused table-build + select + lane-tree ----------------------
+
+def _tree_to_tail(pt: jnp.ndarray, four_p, two_d) -> jnp.ndarray:
+    """(4, 16, T) -> (4, 16, TAIL) pairwise-halving point reduction."""
+    n = pt.shape[-1]
+    while n > TAIL:
+        h = n // 2
+        pt = _pt_add(pt[..., :h], pt[..., h:], four_p, two_d)
+        n = h
+    return pt
+
+
+def _build_table(pt: jnp.ndarray, tab_ref, four_p, two_d) -> None:
+    """tab_ref (16, 4, 16, T) <- [j]pt for j in 0..15 (entry leading)."""
+    t = pt.shape[-1]
+    tab_ref[0] = _pt_identity(t)
+    tab_ref[1] = pt
+    acc = pt
+    for j in range(2, 16):
+        acc = _pt_add(acc, pt, four_p, two_d)
+        tab_ref[j] = acc
+
+
+def _select(tab_ref, dig: jnp.ndarray) -> jnp.ndarray:
+    """Compare-accumulate entry select: dig (T,) in 0..15 ->
+    (4, 16, T)."""
+    acc = jnp.zeros_like(tab_ref[0])
+    for e in range(16):
+        mask = (dig == e).astype(jnp.int32)[None, None, :]
+        acc = acc + tab_ref[e] * mask
+    return acc
+
+
+def _rlc_kernel(c_ref, a_ref, r_ref, tdig_ref, zdig_ref, o_ref,
+                tab_a, tab_r):
+    four_p, two_d = c_ref[0][:, None], c_ref[1][:, None]
+    _build_table(a_ref[:], tab_a, four_p, two_d)
+    _build_table(r_ref[:], tab_r, four_p, two_d)
+
+    def a_window(w, _):
+        sel = _select(tab_a, tdig_ref[w])
+        o_ref[0, w] = _tree_to_tail(sel, four_p, two_d)
+        return 0
+
+    def r_window(w, _):
+        sel = _select(tab_r, zdig_ref[w])
+        o_ref[0, A_WINDOWS + w] = _tree_to_tail(sel, four_p, two_d)
+        return 0
+
+    jax.lax.fori_loop(0, A_WINDOWS, a_window, 0)
+    jax.lax.fori_loop(0, R_WINDOWS, r_window, 0)
+
+
+def rlc_window_sums_impl(a_pt: jnp.ndarray, r_pt: jnp.ndarray,
+                         t_dig: jnp.ndarray, z_dig: jnp.ndarray,
+                         interpret: bool = False) -> jnp.ndarray:
+    """Per-tile window partial sums for the RLC equation.
+
+    a_pt, r_pt: (4, 16, N) packed -A / -R points (already negated,
+    struct-masked z's folded into the digits by the caller).
+    t_dig: (64, N) radix-16 digits of t_i = z_i*k_i.
+    z_dig: (32, N) radix-16 digits of z_i.
+    Returns (G, 96, 4, 16, TAIL) where G = N // TILE: windows 0..63
+    are the -A windows, 64..95 the -R windows; the caller folds the
+    (G, TAIL) axes (tiny XLA tree) and Horners the 64 combined
+    windows exactly as verify_rlc_core does.
+    """
+    n = a_pt.shape[-1]
+    assert n % TILE == 0, (n, TILE)
+    g = n // TILE
+    pt_spec = pl.BlockSpec((4, 16, TILE), lambda i: (0, 0, i),
+                           memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _rlc_kernel,
+        out_shape=jax.ShapeDtypeStruct((g, N_WINDOWS, 4, 16, TAIL),
+                                       jnp.int32),
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((2, 16), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pt_spec, pt_spec,
+            pl.BlockSpec((A_WINDOWS, TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R_WINDOWS, TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, N_WINDOWS, 4, 16, TAIL),
+                               lambda i: (i, 0, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((16, 4, 16, TILE), jnp.int32),
+            pltpu.VMEM((16, 4, 16, TILE), jnp.int32),
+        ],
+        interpret=interpret,
+    )(_consts_array(), a_pt, r_pt, t_dig, z_dig)
+
+
+rlc_window_sums = jax.jit(rlc_window_sums_impl,
+                          static_argnames=("interpret",))
+
+
+def pack_point(p) -> jnp.ndarray:
+    """edwards 4-tuple (each (16, N)) -> packed (4, 16, N)."""
+    return jnp.stack(p)
+
+
+def unpack_point(a: jnp.ndarray):
+    return (a[0], a[1], a[2], a[3])

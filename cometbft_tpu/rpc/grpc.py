@@ -119,9 +119,9 @@ class GRPCServices:
                           f"too many GetLatestHeight streams "
                           f"(max {_MAX_STREAMS})")
         sub_id = f"grpc-latest-height-{uuid.uuid4().hex[:8]}"
-        sub = self.env.event_bus.server.subscribe(
-            sub_id, QUERY_NEW_BLOCK, buffer=64)
         try:
+            sub = self.env.event_bus.server.subscribe(
+                sub_id, QUERY_NEW_BLOCK, buffer=64)
             while context.is_active():
                 got = sub.next(timeout=0.25)
                 if got is None:

@@ -38,9 +38,20 @@ class Pruner:
         self._results_retain = 0         # PruningService block results
         self._tx_index_retain = 0        # PruningService tx indexer
         self._block_index_retain = 0     # PruningService block indexer
+        self._tx_index_applied = 0       # last retain actually scanned
+        self._block_index_applied = 0
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # companion opinions survive restarts (reference pruner reads
+        # them back from the state store)
+        if state_store is not None and \
+                hasattr(state_store, "load_companion_retain_heights"):
+            d = state_store.load_companion_retain_heights()
+            self._companion_retain = d.get("block", 0)
+            self._results_retain = d.get("results", 0)
+            self._tx_index_retain = d.get("tx_index", 0)
+            self._block_index_retain = d.get("block_index", 0)
 
     def set_retain_height(self, height: int) -> None:
         """Called with ResponseCommit.retain_height (0 = keep all)."""
@@ -50,20 +61,33 @@ class Pruner:
 
     # --- companion (privileged PruningService) setters ---------------------
 
+    def _persist_companion(self) -> None:
+        if self.state_store is not None and \
+                hasattr(self.state_store, "save_companion_retain_heights"):
+            self.state_store.save_companion_retain_heights({
+                "block": self._companion_retain,
+                "results": self._results_retain,
+                "tx_index": self._tx_index_retain,
+                "block_index": self._block_index_retain})
+
     def set_companion_block_retain_height(self, height: int) -> None:
         self._companion_retain = height
+        self._persist_companion()
         self._wake.set()
 
     def set_block_results_retain_height(self, height: int) -> None:
         self._results_retain = height
+        self._persist_companion()
         self._wake.set()
 
     def set_tx_indexer_retain_height(self, height: int) -> None:
         self._tx_index_retain = height
+        self._persist_companion()
         self._wake.set()
 
     def set_block_indexer_retain_height(self, height: int) -> None:
         self._block_index_retain = height
+        self._persist_companion()
         self._wake.set()
 
     def retain_heights(self) -> dict:
@@ -92,11 +116,16 @@ class Pruner:
             # from it (reference pruner.go keeps the tip)
             self.state_store.prune_abci_responses(
                 min(self._results_retain, self.block_store.height()))
-        if self._tx_index_retain > 0 and self.tx_indexer is not None:
+        # the indexer prunes are FULL SCANS of their stores — run them
+        # only when the retain height actually moved, not every wake
+        if self.tx_indexer is not None and \
+                self._tx_index_retain > self._tx_index_applied:
             self.tx_indexer.prune(self._tx_index_retain)
-        if self._block_index_retain > 0 and \
-                self.block_indexer is not None:
+            self._tx_index_applied = self._tx_index_retain
+        if self.block_indexer is not None and \
+                self._block_index_retain > self._block_index_applied:
             self.block_indexer.prune(self._block_index_retain)
+            self._block_index_applied = self._block_index_retain
         return pruned
 
     def start(self) -> None:

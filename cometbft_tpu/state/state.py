@@ -213,18 +213,30 @@ class StateStore:
         return self._db.get(b"abci:" + height.to_bytes(8, "big"))
 
     def prune(self, retain_height: int) -> int:
-        """Delete ABCI responses and validator sets below retain_height
-        (reference state/store.go PruneStates — the store owns its key
-        layout). Iterates only existing keys, so repeated calls are
-        O(newly-prunable)."""
-        deletes = []
-        for prefix in (b"abci:", b"vals:"):
-            end = prefix + retain_height.to_bytes(8, "big")
-            for k, _v in self._db.iterate(prefix, end):
-                deletes.append(k)
+        """Delete validator sets below retain_height (reference
+        state/store.go PruneStates — the store owns its key layout).
+        FinalizeBlock responses are deliberately NOT touched: they are
+        pruned only by the data companion's results retain height
+        (`prune_abci_responses`, reference PruneABCIResponses) or never
+        stored at all under [storage] discard_abci_responses. Iterates
+        only existing keys, so repeated calls are O(newly-prunable)."""
+        prefix = b"vals:"
+        end = prefix + retain_height.to_bytes(8, "big")
+        deletes = [k for k, _v in self._db.iterate(prefix, end)]
         if deletes:
             self._db.write_batch([], deletes)
         return len(deletes)
+
+    def save_companion_retain_heights(self, d: dict) -> None:
+        """Persist the pruning-service retain heights (reference
+        state/store.go saveCompanionBlockRetainHeight et al.) so a
+        restart doesn't silently forget the data companion's prune
+        opinions."""
+        self._db.set(b"companion_retain", json.dumps(d).encode())
+
+    def load_companion_retain_heights(self) -> dict:
+        raw = self._db.get(b"companion_retain")
+        return json.loads(raw) if raw else {}
 
     def prune_abci_responses(self, retain_height: int) -> int:
         """Delete only FinalizeBlock responses below retain_height

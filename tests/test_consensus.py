@@ -84,6 +84,23 @@ def test_skip_timeout_commit_fast_path():
         c.stop()
 
 
+def test_single_validator_deep_chain_no_recursion():
+    """A lone validator with skip_timeout_commit chains commit -> next
+    proposal with no waiting anywhere; own-message delivery must be
+    iterative (the internal queue drain in handle_msg), or the
+    consensus thread dies of RecursionError after ~35 uninterrupted
+    heights (~30 stack frames per height). Regression: found by a
+    round-4 verify drive; 50 heights overflow the pre-fix stack."""
+    from dataclasses import replace as dc_replace
+    c = Cluster(1, config=dc_replace(FAST_CONFIG, timeout_commit=0))
+    try:
+        c.start()
+        c.wait_for_height(50, timeout=120)
+        assert c.nodes[0].cs._thread.is_alive()
+    finally:
+        c.stop()
+
+
 def test_round_skip_when_proposer_down():
     """Height advances past a silent proposer via round > 0 (reference
     state_test.go proposer-timeout behavior)."""
