@@ -182,7 +182,7 @@ def measure(batch, iters):
     dt = time.perf_counter() - t0
     assert bool(bok)
     _log(f"{iters} x {batch} sigs in {dt:.3f}s")
-    return batch * iters / dt, compile_secs
+    return batch * iters / dt, compile_secs, which
 
 
 def _measure_mode(batch: int, iters: int) -> int:
@@ -195,7 +195,7 @@ def _measure_mode(batch: int, iters: int) -> int:
     import jax
     dev = jax.devices()[0]
     _log(f"measure[{batch}]: devices: {jax.devices()}")
-    sigs_per_sec, _compile = measure(batch, iters)
+    sigs_per_sec, _compile, which = measure(batch, iters)
     rec = {
         "metric": "ed25519_batch_verify_throughput",
         "value": round(sigs_per_sec, 1),
@@ -204,7 +204,7 @@ def _measure_mode(batch: int, iters: int) -> int:
         "batch": batch,
         # which point-stage implementation produced the number — the
         # xla fallback must be distinguishable from a pallas result
-        "kernel": os.environ.get("BENCH_KERNEL") or "auto",
+        "kernel": which,
     }
     if dev.platform == "cpu":
         rec["backend"] = "cpu"

@@ -340,9 +340,15 @@ class Node:
         tunnel): only an explicit non-cpu leading platform gets the
         device path; cpu/undetermined stays native (jitting the RLC
         kernel on XLA:CPU costs minutes per bucket and crashes the
-        compiler outright at batch >=256 — docs/PERF.md)."""
+        compiler outright at batch >=256 — docs/PERF.md). The device
+        batch matches the pallas lane tile: a sub-TILE batch would
+        silently route every node verify to the XLA kernel
+        (ops/ed25519._rlc_dispatch alignment check)."""
         from ..libs.jax_cache import is_device_platform
-        return 256 if is_device_platform() else 0
+        if not is_device_platform():
+            return 0
+        from ..ops.pallas_verify import TILE
+        return TILE
 
     def _prewarm_kernels(self) -> None:
         if self._device_batch_size() <= 0:

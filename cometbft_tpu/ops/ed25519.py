@@ -162,16 +162,23 @@ def verify_rlc_core_pallas(pub: jnp.ndarray, sig: jnp.ndarray,
     below their fe_mul content (docs/PERF.md) — past a few hundred
     HLOs the fuser stops fusing and intermediates round-trip HBM.
     """
-    from .pallas_verify import (A_WINDOWS, TAIL, pack_point,
+    from .field import fe_neg
+    from .pallas_verify import (TAIL, pt_decompress_tiled,
                                 rlc_window_sums)
+
+    def neg_packed(p):
+        return jnp.stack([fe_neg(p[0]), p[1], p[2], fe_neg(p[3])])
 
     sig_b = jnp.moveaxis(sig, -1, 0)                   # (64, N)
     r_enc, s_enc = sig_b[:32], sig_b[32:]
     s = bytes_to_limbs(s_enc.astype(jnp.int32))        # (16, N)
     s_ok = sc_lt_l(s)
 
-    a_pt, a_ok = ed.pt_decompress(jnp.moveaxis(pub, -1, 0), zip215=True)
-    r_pt, r_ok = ed.pt_decompress(r_enc, zip215=True)
+    # tiled pallas decompression (2x 12.4ms per verify via XLA on the
+    # chip — the next bottleneck after the window stage)
+    a_pt, a_ok = pt_decompress_tiled(jnp.moveaxis(pub, -1, 0),
+                                     interpret=interpret)
+    r_pt, r_ok = pt_decompress_tiled(r_enc, interpret=interpret)
 
     digest = jnp.moveaxis(sha512_blocks(hblocks, hnblocks), -1, 0)
     k = sc_reduce_wide(bytes_to_limbs(digest.astype(jnp.int32)))
@@ -186,25 +193,21 @@ def verify_rlc_core_pallas(pub: jnp.ndarray, sig: jnp.ndarray,
 
     # fused point stage: per-(tile, window) partial sums of -A and -R
     out = rlc_window_sums(
-        pack_point(ed.pt_neg(a_pt)), pack_point(ed.pt_neg(r_pt)),
+        neg_packed(a_pt), neg_packed(r_pt),
         sc_nibbles(t), sc_nibbles(z16)[:ZWIN], interpret=interpret)
     g = out.shape[0]
-    # (G, 96, 4, 16, TAIL) -> coords (16, 96, G*TAIL), then fold lanes
+    # (G, 96, 4, 16, TAIL) -> coords (4, 16, 96, G*TAIL); the epilogue
+    # kernel folds lanes, combines the R windows, adds the shared-base
+    # [S]B windows, Horners, clears the cofactor, and tests identity —
+    # all point math stays in VMEM (tiny-shape pt ops are latency-bound
+    # in XLA on the chip)
+    from .pallas_verify import rlc_epilogue
     folded = jnp.transpose(out, (2, 3, 1, 0, 4)).reshape(
         4, 16, out.shape[1], g * TAIL)
-    wsum = ed.pt_tree_sum(tuple(folded[i] for i in range(4)))
-    w_a = tuple(c[:, :A_WINDOWS] for c in wsum)        # (16, 64)
-    w_r = tuple(c[:, A_WINDOWS:] for c in wsum)        # (16, 32)
-    lo = ed.pt_add(tuple(c[:, :ZWIN] for c in w_a), w_r)
-    w = tuple(jnp.concatenate([cl, ca[:, ZWIN:]], axis=1)
-              for cl, ca in zip(lo, w_a))
-
-    b_tab = jnp.asarray(ed.small_base_table())
-    w = ed.pt_add(w, ed._lookup_shared(b_tab, sc_nibbles(s_sum)))
-
-    acc = ed.horner_windows(w)
-    acc = ed.pt_double(ed.pt_double(ed.pt_double(acc)))
-    return ed.pt_is_identity(acc), struct_ok
+    batch_ok = rlc_epilogue(
+        folded, jnp.asarray(ed.small_base_table()),
+        sc_nibbles(s_sum), interpret=interpret)
+    return batch_ok, struct_ok
 
 
 verify_rlc_kernel_pallas = jax.jit(verify_rlc_core_pallas,
@@ -340,11 +343,16 @@ _pallas_broken = False
 
 def _rlc_dispatch(pub_a, sig_a, hb, hn, z):
     """RLC verify via the pallas point-stage on device platforms,
-    degrading PERMANENTLY to the proven XLA kernel on any pallas
+    degrading PERMANENTLY to the proven XLA kernel on a real pallas
     failure (mosaic compile/runtime errors must not crash blocksync,
-    and a failing compile must not be re-paid per batch)."""
+    and a failing compile must not be re-paid per batch). Batches not
+    aligned to the pallas lane tile take the XLA kernel WITHOUT
+    tripping the sticky latch — a small one-off verify must not
+    disable pallas for later aligned blocksync tiles."""
     global _pallas_broken
-    if use_pallas_rlc() and not _pallas_broken:
+    from .pallas_verify import TILE
+    aligned = pub_a.shape[0] % TILE == 0
+    if use_pallas_rlc() and aligned and not _pallas_broken:
         try:
             return verify_rlc_kernel_pallas(pub_a, sig_a, hb, hn, z)
         except Exception:  # noqa: BLE001

@@ -80,16 +80,28 @@ def _carry(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(rows)
 
 
+def _bcast(c: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    """Right-pad a limb constant ((16,) or (16,1...)) with singleton
+    batch dims to `like`'s rank — the limb axis is LEADING, so plain
+    trailing-aligned numpy broadcasting would misalign it."""
+    if c.ndim < like.ndim:
+        return c.reshape(c.shape[0], *([1] * (like.ndim - 1)))
+    return c
+
+
 def _mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """fe_mul on (16, T) with the same exactness bounds as
+    """fe_mul on (16, *batch) with the same exactness bounds as
     field.spread_mul (strict 16-bit limbs in, one uint32 outer product,
-    lo/hi split, schoolbook shift-add, fold 2^256=38, carry)."""
+    lo/hi split, schoolbook shift-add, fold 2^256=38, carry). Operands
+    of unequal rank are limb-axis-aligned first."""
+    a, b = _bcast(a, b), _bcast(b, a)
     au = a.astype(jnp.uint32)
     bu = b.astype(jnp.uint32)
-    p = au[:, None] * bu[None]                     # (16, 16, T) exact
+    p = au[:, None] * bu[None]                     # (16, 16, ...) exact
     lo = (p & MASK).astype(jnp.int32)
     hi = (p >> LIMB_BITS).astype(jnp.int32)
-    acc = [jnp.zeros_like(a[0]) for _ in range(32)]
+    zero = jnp.zeros_like(jnp.broadcast_to(a[0], p.shape[2:]))
+    acc = [zero for _ in range(32)]
     for i in range(16):
         for j in range(16):
             acc[i + j] = acc[i + j] + lo[i, j]
@@ -98,12 +110,15 @@ def _mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _carry(jnp.stack(folded))
 
 
-# Pallas kernels may not close over constant arrays — the two field
-# constants ride in as a (2, 16) input: row 0 = 4p, row 1 = 2d.
+# Pallas kernels may not close over constant arrays — the field
+# constants ride in as a (5, 16) input:
+# row 0 = 4p, 1 = 2d, 2 = p, 3 = d, 4 = sqrt(-1).
 def _consts_array() -> jnp.ndarray:
-    from .edwards import TWO_D_LIMBS
+    from .edwards import D_LIMBS, SQRT_M1_LIMBS, TWO_D_LIMBS
+    from .field import P_LIMBS
     import numpy as np
-    return jnp.asarray(np.stack([FOUR_P_LIMBS, TWO_D_LIMBS]),
+    return jnp.asarray(np.stack([FOUR_P_LIMBS, TWO_D_LIMBS, P_LIMBS,
+                                 D_LIMBS, SQRT_M1_LIMBS]),
                        dtype=jnp.int32)
 
 
@@ -112,12 +127,13 @@ def _add(a, b):
 
 
 def _sub(a, b, four_p):
-    return _carry(a + four_p - b)
+    return _carry(a + _bcast(four_p, a) - b)
 
 
 def _pt_add(p: jnp.ndarray, q: jnp.ndarray, four_p, two_d) -> jnp.ndarray:
-    """add-2008-hwcd-3 on (4, 16, T) packed points (same formula as
-    edwards.pt_add). four_p/two_d: (16, 1) broadcastable constants."""
+    """add-2008-hwcd-3 on (4, 16, *batch) packed points (same formula
+    as edwards.pt_add). four_p/two_d: (16,)-leading constants, rank-
+    normalized internally."""
     x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
     x2, y2, z2, t2 = q[0], q[1], q[2], q[3]
     a = _mul(_sub(y1, x1, four_p), _sub(y2, x2, four_p))
@@ -131,17 +147,129 @@ def _pt_add(p: jnp.ndarray, q: jnp.ndarray, four_p, two_d) -> jnp.ndarray:
     return jnp.stack([_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h)])
 
 
+def _pt_double(p: jnp.ndarray, four_p) -> jnp.ndarray:
+    """dbl-2008-hwcd on a packed point (edwards.pt_double)."""
+    x1, y1, z1 = p[0], p[1], p[2]
+    a = _mul(x1, x1)
+    b = _mul(y1, y1)
+    c = _carry(2 * _mul(z1, z1))
+    h = _add(a, b)
+    xy = _add(x1, y1)
+    e = _sub(h, _mul(xy, xy), four_p)
+    g = _sub(a, b, four_p)
+    f = _add(c, g)
+    return jnp.stack([_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h)])
+
+
 def _pt_identity(t: int) -> jnp.ndarray:
     z = jnp.zeros((16, t), dtype=jnp.int32)
     one = z.at[0].set(1)
     return jnp.stack([z, one, one, z])
 
 
+# --- decompress helpers (mirror field.py/edwards.py with consts
+# passed in; same bounds proofs) -------------------------------------------
+
+def _cond_sub_p(x: jnp.ndarray, p_limbs) -> jnp.ndarray:
+    """Subtract p when x >= p (x fully carried); one borrow pass
+    decides both (field._cond_sub_p)."""
+    d = x - _bcast(p_limbs, x)
+    c = jnp.zeros_like(d[0])
+    rows = []
+    for i in range(16):
+        v = d[i] + c
+        rows.append(v & MASK)
+        c = v >> LIMB_BITS
+    sub = jnp.stack(rows)
+    return jnp.where((c == 0)[None], sub, x)
+
+
+def _canonical(x: jnp.ndarray, p_limbs) -> jnp.ndarray:
+    x = _carry(x)
+    x = _cond_sub_p(x, p_limbs)
+    return _cond_sub_p(x, p_limbs)
+
+
+def _eq(a, b, four_p, p_limbs) -> jnp.ndarray:
+    d = _canonical(_sub(a, b, four_p), p_limbs)
+    return jnp.all(d == 0, axis=0)
+
+
+def _neg(a, four_p):
+    return _carry(_bcast(four_p, a) - a)
+
+
+def _nsq(x, n):
+    def step(_, c):
+        return _mul(c, c)
+    return jax.lax.fori_loop(0, n, step, x)
+
+
+def _pow2523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(2^252 - 3), the ref10 chain (field.fe_pow2523) with
+    fori_loops for the long square runs."""
+    t0 = _mul(z, z)
+    t1 = _nsq(t0, 2)
+    t1 = _mul(z, t1)
+    t0 = _mul(t0, t1)
+    t0 = _mul(t0, t0)
+    t0 = _mul(t1, t0)
+    t1 = _nsq(t0, 5)
+    t0 = _mul(t1, t0)
+    t1 = _nsq(t0, 10)
+    t1 = _mul(t1, t0)
+    t2 = _nsq(t1, 20)
+    t1 = _mul(t2, t1)
+    t1 = _nsq(t1, 10)
+    t0 = _mul(t1, t0)
+    t1 = _nsq(t0, 50)
+    t1 = _mul(t1, t0)
+    t2 = _nsq(t1, 100)
+    t1 = _mul(t2, t1)
+    t1 = _nsq(t1, 50)
+    t0 = _mul(t1, t0)
+    t0 = _nsq(t0, 2)
+    return _mul(t0, z)
+
+
+def _bytes_to_limbs(b: jnp.ndarray) -> jnp.ndarray:
+    """(32, T) int32 bytes -> (16, T) 16-bit limbs (scalar.bytes_to_limbs)."""
+    return b[0::2] | (b[1::2] << 8)
+
+
+def _decompress(b: jnp.ndarray, consts):
+    """(32, T) int32 bytes -> packed point (4, 16, T), valid (T,).
+    ZIP-215 semantics, mirroring edwards.pt_decompress."""
+    four_p = consts[0]
+    p_limbs = consts[2]
+    d_limbs = consts[3]
+    sqrt_m1 = consts[4]
+
+    sign = (b[31] >> 7) & 1
+    yb = jnp.concatenate([b[:31], (b[31] & 0x7F)[None]], axis=0)
+    y = _bytes_to_limbs(yb)
+
+    yy = _mul(y, y)
+    one = jnp.zeros_like(y).at[0].set(1)
+    u = _sub(yy, one, four_p)
+    v = _add(_mul(yy, d_limbs), one)
+    v3 = _mul(_mul(v, v), v)
+    v7 = _mul(_mul(v3, v3), v)
+    x = _mul(_mul(u, v3), _pow2523(_mul(u, v7)))
+    vxx = _mul(v, _mul(x, x))
+    ok_direct = _eq(vxx, u, four_p, p_limbs)
+    ok_twisted = _eq(vxx, _neg(u, four_p), four_p, p_limbs)
+    x = jnp.where(ok_twisted[None], _mul(x, sqrt_m1), x)
+    valid = ok_direct | ok_twisted
+    parity = _canonical(x, p_limbs)[0] & 1
+    x = jnp.where((parity != sign)[None], _neg(x, four_p), x)
+    return jnp.stack([x, y, one, _mul(x, y)]), valid
+
+
 # --- kernel 1: standalone tiled pt_add (A/B de-risk) ----------------------
 
 def _pt_add_kernel(c_ref, p_ref, q_ref, o_ref):
-    four_p, two_d = c_ref[0][:, None], c_ref[1][:, None]
-    o_ref[:] = _pt_add(p_ref[:], q_ref[:], four_p, two_d)
+    o_ref[:] = _pt_add(p_ref[:], q_ref[:], c_ref[0], c_ref[1])
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -156,7 +284,7 @@ def pt_add_tiled(p: jnp.ndarray, q: jnp.ndarray,
         _pt_add_kernel,
         out_shape=jax.ShapeDtypeStruct(p.shape, jnp.int32),
         grid=grid,
-        in_specs=[pl.BlockSpec((2, 16), lambda i: (0, 0),
+        in_specs=[pl.BlockSpec((5, 16), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
                   spec, spec],
         out_specs=spec,
@@ -199,7 +327,7 @@ def _select(tab_ref, dig: jnp.ndarray) -> jnp.ndarray:
 
 def _rlc_kernel(c_ref, a_ref, r_ref, tdig_ref, zdig_ref, o_ref,
                 tab_a, tab_r):
-    four_p, two_d = c_ref[0][:, None], c_ref[1][:, None]
+    four_p, two_d = c_ref[0], c_ref[1]
     _build_table(a_ref[:], tab_a, four_p, two_d)
     _build_table(r_ref[:], tab_r, four_p, two_d)
 
@@ -242,7 +370,7 @@ def rlc_window_sums_impl(a_pt: jnp.ndarray, r_pt: jnp.ndarray,
                                        jnp.int32),
         grid=(g,),
         in_specs=[
-            pl.BlockSpec((2, 16), lambda i: (0, 0),
+            pl.BlockSpec((5, 16), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pt_spec, pt_spec,
             pl.BlockSpec((A_WINDOWS, TILE), lambda i: (0, i),
@@ -263,6 +391,133 @@ def rlc_window_sums_impl(a_pt: jnp.ndarray, r_pt: jnp.ndarray,
 
 rlc_window_sums = jax.jit(rlc_window_sums_impl,
                           static_argnames=("interpret",))
+
+
+# --- kernel 3: tiled ZIP-215 point decompression ---------------------------
+
+def _decompress_kernel(c_ref, b_ref, pt_ref, ok_ref):
+    pt, valid = _decompress(b_ref[:], c_ref)
+    pt_ref[:] = pt
+    ok_ref[:] = valid[None].astype(jnp.int32)
+
+
+def pt_decompress_tiled_impl(enc: jnp.ndarray,
+                             interpret: bool = False):
+    """ZIP-215 decompression of (32, N) byte-leading encodings on lane
+    tiles (the pallas analog of edwards.pt_decompress — 2x 12.4ms per
+    RLC verify on the chip via XLA, docs/PERF.md). Returns
+    (packed (4,16,N) int32, valid (N,) bool)."""
+    n = enc.shape[-1]
+    assert n % TILE == 0, (n, TILE)
+    pt, ok = pl.pallas_call(
+        _decompress_kernel,
+        out_shape=(jax.ShapeDtypeStruct((4, 16, n), jnp.int32),
+                   jax.ShapeDtypeStruct((1, n), jnp.int32)),
+        grid=(n // TILE,),
+        in_specs=[
+            pl.BlockSpec((5, 16), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((32, TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec((4, 16, TILE), lambda i: (0, 0, i),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, TILE), lambda i: (0, i),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(_consts_array(), enc.astype(jnp.int32))
+    return pt, ok[0].astype(bool)
+
+
+pt_decompress_tiled = jax.jit(pt_decompress_tiled_impl,
+                              static_argnames=("interpret",))
+
+
+# --- kernel 4: the RLC epilogue (fold + combine + [S]B + Horner) -----------
+#
+# After the window stage, everything left is point arithmetic on TINY
+# shapes (96 windows x G*TAIL lanes, then a single accumulator point) —
+# in XLA on the chip those ops are latency-bound at ~1-2ms each, which
+# would cap the whole verify once the wide stages are fused. One
+# single-program kernel keeps the entire tail in VMEM.
+
+def _epilogue_kernel(c_ref, w_ref, btab_ref, sdig_ref, ok_ref):
+    four_p = c_ref[0]
+    two_d = c_ref[1]
+    p_limbs = c_ref[2]
+
+    # fold the (96, M) lane axis: coords (4, 16, 96, M) -> (4, 16, 96)
+    w = w_ref[:]
+    m = w.shape[-1]
+    while m > 1:
+        h = m // 2
+        w = _pt_add(w[..., :h], w[..., h:], four_p, two_d)
+        m = h
+    w = w[..., 0]                                     # (4, 16, 96)
+
+    # combine: windows 0..31 of -A pick up -R's 32 windows
+    lo = _pt_add(w[..., :R_WINDOWS], w[..., A_WINDOWS:],
+                 four_p, two_d)
+    w = jnp.concatenate([lo, w[..., R_WINDOWS:A_WINDOWS]], axis=-1)
+
+    # fold [S]B via the shared base table: btab (16, 4, 16),
+    # sdig (64, 1) -> selected (4, 16, 64)
+    sdig = sdig_ref[:, 0]                             # (64,)
+    sel = jnp.zeros((4, 16, A_WINDOWS), dtype=jnp.int32)
+    for e in range(16):
+        mask = (sdig == e).astype(jnp.int32)[None, None, :]
+        sel = sel + btab_ref[e][:, :, None] * mask
+    w = _pt_add(w, sel, four_p, two_d)
+
+    # radix-16 Horner over the 64 windows, most significant first
+    def step(i, acc):
+        idx = A_WINDOWS - 2 - i
+        acc = _pt_double(acc, four_p)
+        acc = _pt_double(acc, four_p)
+        acc = _pt_double(acc, four_p)
+        acc = _pt_double(acc, four_p)
+        wi = jax.lax.dynamic_slice(
+            w, (0, 0, idx), (4, 16, 1))[..., 0]
+        return _pt_add(acc, wi, four_p, two_d)
+
+    acc = w[..., A_WINDOWS - 1]
+    acc = jax.lax.fori_loop(0, A_WINDOWS - 1, step, acc)
+
+    # clear the cofactor, then the projective identity test
+    acc = _pt_double(_pt_double(_pt_double(acc, four_p), four_p), four_p)
+    x_zero = jnp.all(_canonical(acc[0], p_limbs) == 0, axis=0)
+    yz_eq = jnp.all(
+        _canonical(_sub(acc[1], acc[2], four_p), p_limbs) == 0, axis=0)
+    ok_ref[0, 0] = (x_zero & yz_eq).astype(jnp.int32)
+
+
+def rlc_epilogue_impl(folded: jnp.ndarray, b_tab: jnp.ndarray,
+                      s_dig: jnp.ndarray,
+                      interpret: bool = False) -> jnp.ndarray:
+    """folded: (4, 16, 96, M) window partials (M = G*TAIL lanes);
+    b_tab: (16, 4, 16) shared [j]B table; s_dig: (64,) radix-16 digits
+    of S = sum(z_i s_i). Returns the scalar batch verdict (bool)."""
+    m = folded.shape[-1]
+    assert (m & (m - 1)) == 0, m   # power-of-two fold
+    ok = pl.pallas_call(
+        _epilogue_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        in_specs=[
+            pl.BlockSpec((5, 16), memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, 16, N_WINDOWS, m),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((16, 4, 16), memory_space=pltpu.VMEM),
+            pl.BlockSpec((A_WINDOWS, 1), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(_consts_array(), folded, b_tab.astype(jnp.int32),
+      s_dig.reshape(A_WINDOWS, 1).astype(jnp.int32))
+    return ok[0, 0].astype(bool)
+
+
+rlc_epilogue = jax.jit(rlc_epilogue_impl,
+                       static_argnames=("interpret",))
 
 
 def pack_point(p) -> jnp.ndarray:

@@ -48,6 +48,34 @@ def _affine(packed, lane):
     return (xi * zinv % ref.P, yi * zinv % ref.P)
 
 
+def test_pt_decompress_tiled_matches_edwards():
+    """The pallas decompression agrees with edwards.pt_decompress on
+    valid points, ZIP-215 non-canonical y (0xff*32 decodes!), and
+    undecodable encodings (y=2^255-2 is not on the curve)."""
+    import jax.numpy as _jnp
+    from cometbft_tpu.crypto import ref_ed25519 as ref_mod
+
+    rng = np.random.default_rng(21)
+    n = pv.TILE
+    encs = []
+    for i in range(n - 2):
+        seed = bytes([int(b) for b in rng.integers(0, 256, 32)])
+        encs.append(ref_mod.pubkey_from_seed(seed))
+    encs.append(b"\xff" * 32)                       # ZIP-215: valid
+    encs.append((2**255 - 2).to_bytes(32, "little"))  # off-curve
+    b = _jnp.asarray(np.stack([np.frombuffer(e, np.uint8)
+                               for e in encs], axis=-1))
+
+    got_pt, got_ok = pv.pt_decompress_tiled(b, interpret=True)
+    want_pt, want_ok = ed.pt_decompress(b, zip215=True)
+    got_ok, want_ok = np.asarray(got_ok), np.asarray(want_ok)
+    assert (got_ok == want_ok).all()
+    assert got_ok[:-1].all() and not got_ok[-1]
+    for lane in (0, 1, n - 3, n - 2):
+        assert _affine(got_pt, lane) == \
+            _affine(pv.pack_point(want_pt), lane)
+
+
 def test_pt_add_tiled_matches_edwards():
     rng = np.random.default_rng(11)
     n = 2 * pv.TILE          # two grid programs
@@ -58,6 +86,54 @@ def test_pt_add_tiled_matches_edwards():
     want = pv.pack_point(ed.pt_add(p, q))
     for lane in (0, 1, pv.TILE, n - 1):
         assert _affine(got, lane) == _affine(want, lane)
+
+
+def test_rlc_epilogue_identity_detection():
+    """The epilogue kernel (fold + combine + [S]B + Horner + cofactor +
+    identity test) distinguishes cancelling window partials (verdict
+    True) from non-cancelling ones (False), matching the XLA tail."""
+    from cometbft_tpu.ops import pallas_verify as pvk
+
+    rng = np.random.default_rng(31)
+    m = 8
+    # all-identity partials with S=0: every window sums to identity
+    ident = np.zeros((4, 16, 96, m), np.int32)
+    ident[1, 0] = 1   # y = 1
+    ident[2, 0] = 1   # z = 1
+    b_tab = jnp.asarray(ed.small_base_table())
+    sdig0 = jnp.zeros((64,), jnp.int32)
+    ok = pvk.rlc_epilogue(jnp.asarray(ident), b_tab, sdig0,
+                          interpret=True)
+    assert bool(ok)
+
+    # inject P at (window 5, lane 0) and -P at (window 5, lane 3):
+    # they cancel inside the fold -> still identity
+    x, y, z, _t = ref.pt_mul(12345, ref.BASE)
+    zi = pow(z, ref.P - 2, ref.P)
+    xa, ya = x * zi % ref.P, y * zi % ref.P
+    arr = ident.copy()
+    for ci, v in enumerate((xa, ya, 1, xa * ya % ref.P)):
+        arr[ci, :, 5, 0] = limbs_from_int(v)
+    for ci, v in enumerate((ref.P - xa, ya, 1,
+                            (ref.P - xa) * ya % ref.P)):
+        arr[ci, :, 5, 3] = limbs_from_int(v)
+    ok = pvk.rlc_epilogue(jnp.asarray(arr), b_tab, sdig0,
+                          interpret=True)
+    assert bool(ok)
+
+    # un-cancelled point -> not identity
+    arr2 = ident.copy()
+    for ci, v in enumerate((xa, ya, 1, xa * ya % ref.P)):
+        arr2[ci, :, 7, 1] = limbs_from_int(v)
+    ok = pvk.rlc_epilogue(jnp.asarray(arr2), b_tab, sdig0,
+                          interpret=True)
+    assert not bool(ok)
+
+    # S != 0 alone -> [S]B is not identity -> False
+    sdig = jnp.zeros((64,), jnp.int32).at[0].set(3)
+    ok = pvk.rlc_epilogue(jnp.asarray(ident), b_tab, sdig,
+                          interpret=True)
+    assert not bool(ok)
 
 
 # The fused-kernel interpret tests cost ~20 min EACH on one core (the
