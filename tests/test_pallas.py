@@ -4,9 +4,20 @@ interpret mode against the XLA edwards ops and the big-int oracle.
 The mosaic-compiled path only exists on real TPU backends; interpret
 mode runs the identical kernel bodies through the JAX interpreter so
 the limb math, table builds, digit selects, and tree reductions are
-validated everywhere the suite runs."""
+validated everywhere the suite runs.
+
+Each real test runs in a FRESH interpreter via the *_isolated wrappers
+(the tests/_mesh_harness.py pattern): the interpret graphs are large
+XLA:CPU compiles, and this jaxlib build segfaults compiling big
+executables in a process that already compiled many prior kernels
+(suite run 2026-07-31: SIGSEGV in backend_compile_and_load at the
+epilogue test after 65% of the suite; the same tests pass in fresh
+processes). The inner tests skip unless PALLAS_TESTS_INPROC=1, which
+the wrappers set for their subprocess."""
 
 import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -16,6 +27,35 @@ from cometbft_tpu.crypto import ref_ed25519 as ref
 from cometbft_tpu.ops import edwards as ed
 from cometbft_tpu.ops import pallas_verify as pv
 from cometbft_tpu.ops.field import int_from_limbs, limbs_from_int
+
+_inproc = pytest.mark.skipif(
+    os.environ.get("PALLAS_TESTS_INPROC") != "1",
+    reason="runs via its *_isolated subprocess wrapper")
+
+
+def _run_isolated(name: str, timeout: float = 1800,
+                  env_extra: dict = None) -> None:
+    env = dict(os.environ, PALLAS_TESTS_INPROC="1", **(env_extra or {}))
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         f"{os.path.abspath(__file__)}::{name}", "-q", "-x"],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, (
+        f"{name} rc={r.returncode}\n--- stdout ---\n{r.stdout[-4000:]}"
+        f"\n--- stderr ---\n{r.stderr[-2000:]}")
+
+
+def test_pt_add_tiled_isolated():
+    _run_isolated("test_pt_add_tiled_matches_edwards")
+
+
+def test_pt_decompress_tiled_isolated():
+    _run_isolated("test_pt_decompress_tiled_matches_edwards")
+
+
+def test_rlc_epilogue_isolated():
+    _run_isolated("test_rlc_epilogue_identity_detection")
 
 
 @pytest.fixture(autouse=True)
@@ -48,6 +88,7 @@ def _affine(packed, lane):
     return (xi * zinv % ref.P, yi * zinv % ref.P)
 
 
+@_inproc
 def test_pt_decompress_tiled_matches_edwards():
     """The pallas decompression agrees with edwards.pt_decompress on
     valid points, ZIP-215 non-canonical y (0xff*32 decodes!), and
@@ -76,6 +117,7 @@ def test_pt_decompress_tiled_matches_edwards():
             _affine(pv.pack_point(want_pt), lane)
 
 
+@_inproc
 def test_pt_add_tiled_matches_edwards():
     rng = np.random.default_rng(11)
     n = 2 * pv.TILE          # two grid programs
@@ -88,6 +130,7 @@ def test_pt_add_tiled_matches_edwards():
         assert _affine(got, lane) == _affine(want, lane)
 
 
+@_inproc
 def test_rlc_epilogue_identity_detection():
     """The epilogue kernel (fold + combine + [S]B + Horner + cofactor +
     identity test) distinguishes cancelling window partials (verdict
@@ -149,6 +192,22 @@ _heavy = pytest.mark.skipif(
 
 @_heavy
 @pytest.mark.slow
+def test_rlc_window_sums_isolated():
+    _run_isolated("test_rlc_window_sums_matches_xla_path",
+                  timeout=3600,
+                  env_extra={"COMETBFT_TPU_HEAVY_TESTS": "1"})
+
+
+@_heavy
+@pytest.mark.slow
+def test_verify_rlc_e2e_isolated():
+    _run_isolated("test_verify_rlc_pallas_end_to_end", timeout=3600,
+                  env_extra={"COMETBFT_TPU_HEAVY_TESTS": "1"})
+
+
+@_inproc
+@_heavy
+@pytest.mark.slow
 def test_rlc_window_sums_matches_xla_path():
     rng = np.random.default_rng(12)
     n = pv.TILE
@@ -177,6 +236,7 @@ def test_rlc_window_sums_matches_xla_path():
         assert _affine(col(wsum, 64 + w), 0) == _affine(col(w_r, w), 0)
 
 
+@_inproc
 @_heavy
 @pytest.mark.slow
 def test_verify_rlc_pallas_end_to_end():

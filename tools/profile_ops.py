@@ -131,6 +131,34 @@ def main():
     timeit("sc_mul (N)", jax.jit(sc_mul), s, k)
     globals()["K"] = K_save
 
+    # pallas kernels (mosaic-compiled — device platforms only; the
+    # XLA-vs-pallas A/B that motivates ops/pallas_verify.py)
+    if jax.devices()[0].platform != "cpu" and \
+            os.environ.get("PROF_PALLAS", "1") == "1":
+        from cometbft_tpu.ops import pallas_verify as pv
+        if N % pv.TILE == 0:
+            packed = jnp.stack(pt)
+            globals()["K"] = 1
+            timeit("PALLAS pt_add tiled (N)",
+                   lambda p: pv.pt_add_tiled(p, p), packed)
+            enc = jnp.asarray(
+                rng.integers(0, 256, size=(32, N), dtype=np.uint8))
+            timeit("PALLAS decompress (N)", pv.pt_decompress_tiled, enc)
+            td = jnp.asarray(rng.integers(0, 16, (64, N), np.int32))
+            zd = jnp.asarray(rng.integers(0, 16, (32, N), np.int32))
+            timeit("PALLAS window_sums (N)",
+                   lambda a, t_, z_: pv.rlc_window_sums(a, a, t_, z_),
+                   packed, td, zd)
+            m = (N // pv.TILE) * pv.TAIL
+            folded = jnp.asarray(rng.integers(
+                0, 1 << 16, size=(4, 16, 96, m), dtype=np.int32))
+            from cometbft_tpu.ops.edwards import small_base_table
+            timeit("PALLAS epilogue (1)",
+                   lambda f: pv.rlc_epilogue(
+                       f, jnp.asarray(small_base_table()),
+                       jnp.zeros((64,), jnp.int32)), folded)
+            globals()["K"] = K_save
+
 
 if __name__ == "__main__":
     main()
