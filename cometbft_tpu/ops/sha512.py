@@ -48,8 +48,13 @@ def _primes(n: int):
 _K64 = [_icbrt(p << 192) & ((1 << 64) - 1) for p in _primes(80)]
 _H64 = [math.isqrt(p << 128) & ((1 << 64) - 1) for p in _primes(8)]
 
-K_HI = jnp.asarray(np.array([k >> 32 for k in _K64], dtype=np.uint32))
-K_LO = jnp.asarray(np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32))
+# host-side numpy, NOT jnp: a module-level jnp.asarray builds a device
+# array at import, which INITIALIZES THE BACKEND — on a host whose TPU
+# tunnel is wedged, `import cometbft_tpu.ops.ed25519` would then hang
+# forever before any code runs. They become trace-time constants inside
+# jit regardless.
+K_HI = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+K_LO = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
 H_HI = np.array([h >> 32 for h in _H64], dtype=np.uint32)
 H_LO = np.array([h & 0xFFFFFFFF for h in _H64], dtype=np.uint32)
 

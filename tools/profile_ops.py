@@ -136,7 +136,11 @@ def main():
     if jax.devices()[0].platform != "cpu" and \
             os.environ.get("PROF_PALLAS", "1") == "1":
         from cometbft_tpu.ops import pallas_verify as pv
-        if N % pv.TILE == 0:
+        g = N // pv.TILE
+        if N % pv.TILE != 0 or (g * pv.TAIL) & (g * pv.TAIL - 1):
+            print(f"pallas section skipped: N={N} needs N % TILE"
+                  f"({pv.TILE}) == 0 and a power-of-two tile count")
+        else:
             packed = jnp.stack(pt)
             globals()["K"] = 1
             timeit("PALLAS pt_add tiled (N)",
