@@ -132,6 +132,28 @@ class Histogram(_Metric):
         return out
 
 
+class CallbackGauge(_Metric):
+    """Gauge whose value is read from a callable at scrape time — for
+    counters owned by modules that must not depend on a Registry (e.g.
+    the ops-layer pallas canary, ops/ed25519.canary_stats)."""
+
+    def __init__(self, name, help_="", fn=None):
+        super().__init__(name, help_, ())
+        self._fn = fn or (lambda: 0.0)
+
+    def value(self) -> float:
+        return float(self._fn())
+
+    def expose(self) -> List[str]:
+        try:
+            v = float(self._fn())
+        except Exception:  # noqa: BLE001 — scrape must never die
+            v = float("nan")
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} gauge",
+                f"{self.name} {v}"]
+
+
 class Registry:
     def __init__(self, namespace: str = "cometbft_tpu"):
         self.namespace = namespace
@@ -150,6 +172,10 @@ class Registry:
                   buckets=DEFAULT_BUCKETS) -> Histogram:
         return self._add(Histogram(f"{self.namespace}_{name}", help_,
                                    label_names, buckets))
+
+    def callback_gauge(self, name, help_="", fn=None) -> CallbackGauge:
+        return self._add(CallbackGauge(f"{self.namespace}_{name}",
+                                       help_, fn))
 
     def _add(self, m):
         with self._lock:

@@ -103,6 +103,28 @@ def verify_rlc_core(pub: jnp.ndarray, sig: jnp.ndarray,
     doublings + 128 adds for per-lane Straus — and every stage is a wide
     vectorized op over the batch.
     """
+    w, s_sum, struct_ok = rlc_local_stage(pub, sig, hblocks, hnblocks, z)
+    return rlc_finish_stage(w, s_sum), struct_ok
+
+
+def rlc_local_stage(pub: jnp.ndarray, sig: jnp.ndarray,
+                    hblocks: jnp.ndarray, hnblocks: jnp.ndarray,
+                    z: jnp.ndarray
+                    ) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray,
+                               jnp.ndarray]:
+    """The lane-local portion of the RLC equation: everything up to ONE
+    point per radix-16 window of the local lanes' −R/−A content, plus
+    the local partial of Σ z_i·s_i mod L.
+
+    This is the shard-local body of the multi-chip path
+    (parallel/verify.verify_rlc_sharded): window sums and scalar
+    partials are the only cross-device state — 64 points + one scalar
+    per device (~25KB), all_gathered over ICI and tree-combined, then
+    finished once by `rlc_finish_stage`. Single-device verify_rlc_core
+    is exactly finish(local(...)).
+
+    Returns (w: 64-window Point coords (16, 64) each, s_partial (16,),
+    struct_ok (N,))."""
     sig_b = jnp.moveaxis(sig, -1, 0)                   # (64, N)
     r_enc, s_enc = sig_b[:32], sig_b[32:]
     s = bytes_to_limbs(s_enc.astype(jnp.int32))        # (16, N)
@@ -133,14 +155,20 @@ def verify_rlc_core(pub: jnp.ndarray, sig: jnp.ndarray,
     lo = ed.pt_add(tuple(c[:, :ZWIN] for c in w_a), w_r)
     w = tuple(jnp.concatenate([cl, ca[:, ZWIN:]], axis=1)
               for cl, ca in zip(lo, w_a))
+    return w, s_sum, struct_ok
 
-    # fold [S]B into the same windows via the shared base table
+
+def rlc_finish_stage(w: Tuple[jnp.ndarray, ...],
+                     s_sum: jnp.ndarray) -> jnp.ndarray:
+    """Fold [S]B into the (globally combined) windows via the shared
+    base table, Horner the windows, clear the cofactor, test identity.
+    Runs once per batch — replicated per device on the mesh path (the
+    work is 64 single-point ops, nothing to shard)."""
     b_tab = jnp.asarray(ed.small_base_table())
     w = ed.pt_add(w, ed._lookup_shared(b_tab, sc_nibbles(s_sum)))
-
     acc = ed.horner_windows(w)
     acc = ed.pt_double(ed.pt_double(ed.pt_double(acc)))  # clear cofactor
-    return ed.pt_is_identity(acc), struct_ok
+    return ed.pt_is_identity(acc)
 
 
 verify_rlc_kernel = jax.jit(verify_rlc_core)
@@ -309,6 +337,21 @@ def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
     types/validation.go:306-315). Strict RFC-8032 mode (zip215=False) is
     per-lane only.
     """
+    dispatch = _rlc_dispatch if (rlc and zip215) else None
+    fallback = functools.partial(verify_kernel, zip215=zip215)
+    return _verify_batch_loop(pubs, msgs, sigs, batch_size,
+                              dispatch, fallback)
+
+
+def _verify_batch_loop(pubs, msgs, sigs, batch_size, dispatch, fallback
+                       ) -> np.ndarray:
+    """The shared host-side chunking protocol behind every batch-verify
+    entry point (single-device `verify_batch` here; the mesh-sharded
+    `parallel.verify.verify_batch_mesh`): pad each chunk to the fixed
+    `batch_size` bucket with power-of-two message capacity, try ONE RLC
+    equation per chunk via `dispatch(pub, sig, hb, hn, z)`, and
+    attribute failed chunks (or serve strict mode, dispatch=None) via
+    the per-lane `fallback(pub, sig, hb, hn)`."""
     n = len(pubs)
     if n == 0:
         return np.zeros((0,), dtype=bool)
@@ -326,35 +369,108 @@ def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
         pub_a, sig_a, hb, hn, ok_mask = prepare_batch(
             pubs[lo:hi], chunk_msgs, sigs[lo:hi], batch_size, cap)
         out = None
-        if rlc and zip215:
+        if dispatch is not None:
             z = make_rlc_coefficients(batch_size)
-            batch_ok, struct_ok = _rlc_dispatch(pub_a, sig_a, hb, hn, z)
+            batch_ok, struct_ok = dispatch(pub_a, sig_a, hb, hn, z)
             if bool(batch_ok):
                 out = np.asarray(struct_ok)
         if out is None:  # attribution fallback / strict mode
-            out = np.asarray(verify_kernel(pub_a, sig_a, hb, hn,
-                                           zip215=zip215))
+            out = np.asarray(fallback(pub_a, sig_a, hb, hn))
         outs.append(out[:hi - lo] & ok_mask[:hi - lo])
     return np.concatenate(outs)
 
 
 _pallas_broken = False
 
+# Mosaic miscompile canary (reference posture: attribution safety,
+# types/validation.go:306-315 — a batch verifier may NEVER accept what
+# per-signature verification would reject). The sticky exception latch
+# above catches pallas kernels that *crash*; a kernel that silently
+# MISCOMPILES and returns batch_ok=True on a batch containing an
+# invalid signature would accept a forgery. So every CANARY_INTERVAL-th
+# aligned dispatch (including the very first — node prewarm and
+# device/server._warm both route here) first re-runs the pallas kernel
+# on the same batch with one lane's s deliberately corrupted: the
+# verdict MUST be False. If the kernel claims True, it is accepting a
+# known-invalid signature — trip the sticky XLA fallback and count it.
+_CANARY_INTERVAL = 16
+_canary = {"runs": 0, "trips": 0}
+_dispatches = 0
+
+
+def canary_stats() -> dict:
+    """Snapshot of mosaic-canary counters ({"runs", "trips"}) — wired
+    into the Prometheus registry as callback gauges (node/node.py)."""
+    return dict(_canary)
+
+
+@functools.lru_cache(maxsize=8)
+def _canary_batch(batch_size: int, n_blocks: int):
+    """Constant canary inputs for one (batch, hash-blocks) bucket: every
+    lane carries the known-good dummy signature — structurally valid BY
+    CONSTRUCTION, so zeroing-out of struct-bad lanes can never mask the
+    tamper — except the last lane, whose s has bit 0 flipped (dummy s is
+    nowhere near L, so the lane stays canonical and the batch EQUATION
+    must fail). Input data is fixed, so an adversary cannot steer the
+    canary; shapes match the production bucket, so the very same
+    compiled executable is exercised."""
+    pub, sig, msg = _dummy()
+    bad = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+    assert int.from_bytes(bad[32:64], "little") < ref.L
+    pubs = [pub] * batch_size
+    msgs = [msg] * batch_size
+    sigs = [sig] * (batch_size - 1) + [bad]
+    cap = max(n_blocks * 128 - 64 - 17, 1)  # msg cap giving >= n_blocks
+    pub_a, sig_a, hb, hn, _ = prepare_batch(pubs, msgs, sigs,
+                                            batch_size, cap)
+    if hb.shape[1] < n_blocks:  # pad the block axis to the bucket shape
+        pad = np.zeros((batch_size, n_blocks - hb.shape[1], 128),
+                       dtype=hb.dtype)
+        hb = np.concatenate([hb, pad], axis=1)
+    else:
+        hb = hb[:, :n_blocks]
+    z = make_rlc_coefficients(batch_size)
+    return pub_a, sig_a, hb, hn, z
+
+
+def _run_canary(batch_size: int, n_blocks: int) -> None:
+    """Execute the tampered-lane canary against the pallas kernel;
+    trips `_pallas_broken` on a silent-accept miscompile. Costs one
+    extra kernel execution (same shapes — same compiled executable) on
+    canary rounds; never a per-lane fallback."""
+    global _pallas_broken
+    pub_a, sig_a, hb, hn, z = _canary_batch(batch_size, n_blocks)
+    _canary["runs"] += 1
+    batch_ok, _ = verify_rlc_kernel_pallas(pub_a, sig_a, hb, hn, z)
+    if bool(batch_ok):
+        _canary["trips"] += 1
+        _pallas_broken = True
+        import sys
+        print("ed25519: PALLAS CANARY TRIPPED — mosaic kernel returned "
+              "batch_ok=True on a batch with a known-invalid lane; "
+              "degrading permanently to the XLA kernel", file=sys.stderr,
+              flush=True)
+
 
 def _rlc_dispatch(pub_a, sig_a, hb, hn, z):
     """RLC verify via the pallas point-stage on device platforms,
     degrading PERMANENTLY to the proven XLA kernel on a real pallas
     failure (mosaic compile/runtime errors must not crash blocksync,
-    and a failing compile must not be re-paid per batch). Batches not
+    and a failing compile must not be re-paid per batch) or on a
+    canary-detected silent miscompile (see _run_canary). Batches not
     aligned to the pallas lane tile take the XLA kernel WITHOUT
     tripping the sticky latch — a small one-off verify must not
     disable pallas for later aligned blocksync tiles."""
-    global _pallas_broken
+    global _pallas_broken, _dispatches
     from .pallas_verify import TILE
     aligned = pub_a.shape[0] % TILE == 0
     if use_pallas_rlc() and aligned and not _pallas_broken:
         try:
-            return verify_rlc_kernel_pallas(pub_a, sig_a, hb, hn, z)
+            if _dispatches % _CANARY_INTERVAL == 0:
+                _run_canary(pub_a.shape[0], hb.shape[1])
+            _dispatches += 1
+            if not _pallas_broken:
+                return verify_rlc_kernel_pallas(pub_a, sig_a, hb, hn, z)
         except Exception:  # noqa: BLE001
             _pallas_broken = True
             import traceback
