@@ -147,21 +147,81 @@ def decode_message(payload: bytes) -> WALMessage:
 
 
 class WAL:
-    """reference internal/consensus/wal.go baseWAL."""
+    """reference internal/consensus/wal.go baseWAL over a rotating file
+    group (reference internal/autofile/group.go).
 
-    def __init__(self, path: str):
+    Layout mirrors autofile.Group: the head file at `path` receives all
+    appends; when it exceeds `head_size_limit` bytes the head is
+    renamed to `path.NNN` (monotonically increasing 3-digit index) at a
+    record boundary and a fresh head is opened — rename+create, both
+    atomic, so a kill between them at worst leaves an empty head.
+    Readers iterate rotated files in index order, then the head. When
+    the group exceeds `total_size_limit`, the OLDEST rotated files are
+    dropped (reference Group.checkTotalSizeLimit group.go:238 — the WAL
+    only ever needs data after the last #ENDHEIGHT; older heights are
+    in the block store).
+
+    Only the head can carry a torn tail (crash mid-append): rotated
+    files are closed at record boundaries, so boot-time CRC repair
+    truncates the head alone."""
+
+    def __init__(self, path: str, head_size_limit: int = 8 << 20,
+                 total_size_limit: int = 1 << 30):
         self.path = path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if os.path.exists(path):
-            good = self._scan_good_prefix()
+            good = self._scan_good_prefix(path)
             if good != os.path.getsize(path):
                 with open(path, "r+b") as f:
                     f.truncate(good)
         self._f = open(path, "ab")
 
-    def _scan_good_prefix(self) -> int:
+    # --- group layout ---------------------------------------------------------
+
+    def _rotated(self) -> List[str]:
+        """Rotated file paths, oldest first (index order)."""
+        d = os.path.dirname(self.path) or "."
+        base = os.path.basename(self.path)
+        out = []
+        for name in os.listdir(d):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    out.append((int(suffix), os.path.join(d, name)))
+        return [p for _, p in sorted(out)]
+
+    def _group_files(self) -> List[str]:
+        return self._rotated() + [self.path]
+
+    def _maybe_rotate(self) -> None:
+        if self._f.tell() < self.head_size_limit:
+            return
+        rotated = self._rotated()
+        nxt = 0
+        if rotated:
+            nxt = int(rotated[-1].rsplit(".", 1)[1]) + 1
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        from ..libs.fail import fail_point
+        fail_point("wal:pre-rotate-rename")
+        os.rename(self.path, f"{self.path}.{nxt:03d}")
+        fail_point("wal:post-rotate-rename")
+        self._f = open(self.path, "ab")
+        # total-size enforcement: drop oldest rotated files
+        files = self._rotated()
+        total = sum(os.path.getsize(p) for p in files + [self.path])
+        while files and total > self.total_size_limit:
+            victim = files.pop(0)
+            total -= os.path.getsize(victim)
+            os.remove(victim)
+
+    @staticmethod
+    def _scan_good_prefix(path: str) -> int:
         good = 0
-        with open(self.path, "rb") as f:
+        with open(path, "rb") as f:
             while True:
                 hdr = f.read(8)
                 if len(hdr) < 8:
@@ -173,9 +233,15 @@ class WAL:
                 good += 8 + ln
         return good
 
+    # --- writes ---------------------------------------------------------------
+
     def write(self, msg: WALMessage) -> None:
         """Buffered append (reference wal.go:107 Write — group-buffered,
-        flushed on ticker; we flush per-record, cheap for a local file)."""
+        flushed on ticker; we flush per-record, cheap for a local file).
+        Rotation happens BEFORE the append so a record never straddles
+        files and ENDHEIGHT markers land in the file whose records they
+        close."""
+        self._maybe_rotate()
         payload = encode_message(msg)
         rec = struct.pack("<II", zlib.crc32(payload), len(payload)) + payload
         self._f.write(rec)
@@ -188,11 +254,14 @@ class WAL:
         self.write(msg)
         os.fsync(self._f.fileno())
 
+    # --- reads ----------------------------------------------------------------
+
     def replay_messages(self, after_height: int) -> List[WALMessage]:
         """All messages after the #ENDHEIGHT marker for `after_height`
-        (reference replay.go:95 catchupReplay + wal.go SearchForEndHeight).
-        If the marker is absent and the WAL is non-empty for a lower
-        height, returns [] (nothing to replay for this height)."""
+        (reference replay.go:95 catchupReplay + wal.go SearchForEndHeight
+        — the search spans the whole rotated group). If the marker is
+        absent and the WAL is non-empty for a lower height, returns []
+        (nothing to replay for this height)."""
         msgs: List[WALMessage] = []
         found = after_height == 0 and self._is_empty_or_starts_fresh()
         for msg in self.iter_messages():
@@ -208,16 +277,32 @@ class WAL:
         return True
 
     def iter_messages(self) -> Iterator[WALMessage]:
-        with open(self.path, "rb") as f:
-            while True:
-                hdr = f.read(8)
-                if len(hdr) < 8:
-                    return
-                crc, ln = struct.unpack("<II", hdr)
-                payload = f.read(ln)
-                if len(payload) < ln or zlib.crc32(payload) != crc:
-                    return
-                yield decode_message(payload)
+        """Stream every record across the group: rotated files oldest
+        first, then the head (reference autofile GroupReader).
+
+        A CRC/length-corrupt record ENDS the whole stream, wherever it
+        sits: continuing into newer files after a gap would hand replay
+        a non-contiguous message sequence (a missed ENDHEIGHT or
+        proposal with its votes still following). The expected case —
+        a torn HEAD tail from a crash mid-append — is already repaired
+        by the constructor; mid-group corruption is disk damage and
+        conservatively truncates replay at the gap (reference
+        WALDecoder's DataCorruptionError posture, wal.go:284)."""
+        for path in self._group_files():
+            try:
+                f = open(path, "rb")
+            except FileNotFoundError:
+                continue  # pruned concurrently by total-size enforcement
+            with f:
+                while True:
+                    hdr = f.read(8)
+                    if len(hdr) < 8:
+                        break
+                    crc, ln = struct.unpack("<II", hdr)
+                    payload = f.read(ln)
+                    if len(payload) < ln or zlib.crc32(payload) != crc:
+                        return  # corrupt record: end the WHOLE stream
+                    yield decode_message(payload)
 
     def close(self) -> None:
         self._f.close()

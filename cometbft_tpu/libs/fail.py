@@ -16,6 +16,21 @@ import threading
 _lock = threading.Lock()
 _counter = 0
 _target = int(os.environ.get("COMETBFT_TPU_FAIL_INDEX", "-1"))
+# label-targeted variant: COMETBFT_TPU_FAIL_LABEL="wal:pre-rotate-rename:0"
+# crashes at the k-th crossing of exactly that label (for points that are
+# crossed data-dependently, e.g. WAL rotation, where a global index is
+# not predictable)
+_label_target: "tuple[str, int] | None" = None
+_label_counter = 0
+_env_label = os.environ.get("COMETBFT_TPU_FAIL_LABEL", "")
+if _env_label:
+    # labels contain colons ("wal:pre-rotate-rename"), so the :k
+    # suffix is optional — a bare label means its first crossing
+    _name, _, _k = _env_label.rpartition(":")
+    if _name and _k.isdigit():
+        _label_target = (_name, int(_k))
+    else:
+        _label_target = (_env_label, 0)
 
 
 def set_fail_index(n: int) -> None:
@@ -25,15 +40,29 @@ def set_fail_index(n: int) -> None:
         _counter = 0
 
 
+def set_fail_label(label: str, k: int = 0) -> None:
+    global _label_target, _label_counter
+    with _lock:
+        _label_target = (label, k)
+        _label_counter = 0
+
+
 def fail_point(label: str = "") -> None:
     """Crash (os._exit, no cleanup — like a power cut) when this is the
-    configured failure index."""
-    global _counter
-    if _target < 0:
+    configured failure index, or the k-th crossing of the configured
+    failure label."""
+    global _counter, _label_counter
+    if _target < 0 and _label_target is None:
         return
+    hit = False
     with _lock:
-        hit = _counter == _target
-        _counter += 1
+        if _target >= 0:
+            hit = _counter == _target
+            _counter += 1
+        if not hit and _label_target is not None \
+                and label == _label_target[0]:
+            hit = _label_counter == _label_target[1]
+            _label_counter += 1
     if hit:
         import sys
         print(f"FAIL_POINT hit: {label}", file=sys.stderr, flush=True)
