@@ -167,6 +167,19 @@ class Ed25519BatchVerifier:
     def verify(self) -> Tuple[bool, List[bool]]:
         if not self._pubs:
             return False, []
+        n = len(self._pubs)
+        eff = self._batch_size or 1 << (n - 1).bit_length()
+        from ..libs.jax_cache import is_device_platform
+        if not is_device_platform() and eff > 64:
+            # CPU backend: jitting the RLC kernel at batch >= 256
+            # takes minutes and can crash the XLA:CPU compiler
+            # (docs/PERF.md); a >64-lane flush on a CPU node runs the
+            # native per-sig verify instead — the same clamp blocksync
+            # applies (engine/blocksync.py:79-89)
+            oks = [Ed25519PubKey(p).verify_signature(m, s)
+                   for p, m, s in zip(self._pubs, self._msgs,
+                                      self._sigs)]
+            return all(oks), oks
         from ..ops.ed25519 import verify_batch
         out = verify_batch(self._pubs, self._msgs, self._sigs,
                            batch_size=self._batch_size)

@@ -88,8 +88,18 @@ class TiledCommitVerifier:
                 len(p) == 32 and Ed25519PubKey(p).verify_signature(m, s)
                 for p, m, s in zip(pubs, msgs, sigs)], dtype=bool)
         else:
-            from ..ops.ed25519 import verify_batch
-            out = verify_batch(pubs, msgs, sigs, batch_size=self.batch_size)
+            from ..parallel.verify import mesh_available
+            if mesh_available():
+                # >1 chip: the sharded RLC path — lanes spread over the
+                # mesh, one all_gather of window partials per tile
+                # (parallel/verify.verify_batch_mesh)
+                from ..parallel.verify import verify_batch_mesh
+                out = verify_batch_mesh(pubs, msgs, sigs,
+                                        batch_size=self.batch_size)
+            else:
+                from ..ops.ed25519 import verify_batch
+                out = verify_batch(pubs, msgs, sigs,
+                                   batch_size=self.batch_size)
 
         for e, rows, needed in metas:
             if rows is None:  # structural failure already decided

@@ -32,3 +32,11 @@ def test_sharded_commit_verify_with_tally():
 
 def test_graft_entry():
     _run("graft")
+
+
+def test_sharded_rlc_fast_path_and_attribution():
+    _run("rlc")
+
+
+def test_blocksync_through_mesh():
+    _run("blocksync", timeout=1800)
