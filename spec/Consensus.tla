@@ -44,9 +44,13 @@ Nil     == CHOOSE v : v \notin Values
 Steps == {"NewHeight", "Propose", "Prevote", "PrevoteWait",
           "Precommit", "PrecommitWait", "Commit"}
 
-\* deterministic proposer rotation (types/validator.py proposer
-\* priority reduces to round-robin under equal powers)
-Proposer(r) == CHOOSE v \in Validators : TRUE
+\* deterministic ROUND-ROBIN proposer rotation (types/validator.py
+\* proposer priority reduces to round-robin under equal powers): a
+\* fixed enumeration of the validator set, advanced one slot per round
+N == Cardinality(Validators)
+Order == CHOOSE seq \in [0..(N-1) -> Validators] :
+             \A i, j \in 0..(N-1) : i # j => seq[i] # seq[j]
+Proposer(r) == Order[r % N]
 
 QuorumSize == (2 * Cardinality(Validators)) \div 3 + 1
 Quorums == {Q \in SUBSET Validators : Cardinality(Q) >= QuorumSize}
@@ -67,21 +71,29 @@ vars == <<step, round, lockedValue, lockedRound, validValue, validRound,
           decision, proposals, prevotes, precommits>>
 
 Init ==
-    /\ step        = [v \in Validators |-> "NewHeight"]
-    /\ round       = [v \in Validators |-> 0]
-    /\ lockedValue = [v \in Validators |-> Nil]
-    /\ lockedRound = [v \in Validators |-> -1]
-    /\ validValue  = [v \in Validators |-> Nil]
-    /\ validRound  = [v \in Validators |-> -1]
-    /\ decision    = [v \in Validators |-> Nil]
+    /\ step        = [v \in Correct |-> "NewHeight"]
+    /\ round       = [v \in Correct |-> 0]
+    /\ lockedValue = [v \in Correct |-> Nil]
+    /\ lockedRound = [v \in Correct |-> -1]
+    /\ validValue  = [v \in Correct |-> Nil]
+    /\ validRound  = [v \in Correct |-> -1]
+    /\ decision    = [v \in Correct |-> Nil]
     /\ proposals   = [r \in Rounds |-> Nil]
-    /\ prevotes    = [r \in Rounds |-> [v \in Validators |-> "none"]]
-    /\ precommits  = [r \in Rounds |-> [v \in Validators |-> "none"]]
+    /\ prevotes    = [r \in Rounds |-> [v \in Correct |-> "none"]]
+    /\ precommits  = [r \in Rounds |-> [v \in Correct |-> "none"]]
 
 \* ---- vote bookkeeping (types/vote_set.py 2/3 accounting) -----------------
+\*
+\* WILDCARD BYZANTINE MODEL: faulty validators count toward EVERY
+\* quorum for EVERY value simultaneously — the standard
+\* over-approximation of equivocation (each Byzantine validator may
+\* send any vote to any peer, so any quorum the adversary wants to
+\* complete, it completes).  Strictly more adversarial than explicit
+\* one-vote-per-round Byzantine actions, and faulty votes carry no
+\* state.  Vote arrays are therefore indexed by CORRECT validators.
 
-PrevotePower(r, x)   == {v \in Validators : prevotes[r][v] = x}
-PrecommitPower(r, x) == {v \in Validators : precommits[r][v] = x}
+PrevotePower(r, x)   == {v \in Correct : prevotes[r][v] = x} \union Byzantine
+PrecommitPower(r, x) == {v \in Correct : precommits[r][v] = x} \union Byzantine
 
 HasPolka(r, x)  == \E Q \in Quorums : Q \subseteq PrevotePower(r, x)
 HasCommit(r, x) == \E Q \in Quorums : Q \subseteq PrecommitPower(r, x)
@@ -90,7 +102,7 @@ HasCommit(r, x) == \E Q \in Quorums : Q \subseteq PrecommitPower(r, x)
 \* _enter_prevote_wait)
 AnyPolka(r) ==
     \E Q \in Quorums :
-        \A v \in Q : prevotes[r][v] # "none"
+        \A v \in Q : v \in Byzantine \/ prevotes[r][v] # "none"
 
 \* ---- actions: the _enter_* handlers --------------------------------------
 
@@ -109,12 +121,12 @@ StartRound(v, r) ==
 
 \* _do_prevote: prevote the locked value if locked; else the proposal if
 \* acceptable (PBTS/validation gates abstract to nondeterministic
-\* acceptance); else nil.  A Byzantine validator may vote anything.
+\* acceptance); else nil.  (Byzantine prevotes need no action — the
+\* wildcard quorum model counts them toward every value already.)
 DoPrevote(v, r, x) ==
     /\ round[v] = r /\ step[v] = "Propose"
     /\ prevotes[r][v] = "none"
-    /\ \/ v \in Byzantine
-       \/ /\ lockedValue[v] # Nil /\ x = lockedValue[v]
+    /\ \/ /\ lockedValue[v] # Nil /\ x = lockedValue[v]
        \/ /\ lockedValue[v] = Nil
           /\ \/ x = proposals[r] /\ x # Nil
              \/ x = Nil          \* invalid/missing/untimely proposal
@@ -129,8 +141,7 @@ PrecommitValue(v, r, x) ==
     /\ precommits[r][v] = "none"
     /\ x \in Values
     /\ HasPolka(r, x)
-    /\ v \in Correct => prevotes[r][v] = x  \* code path: own prevote in
-                                            \* the polka set
+    /\ prevotes[r][v] = x  \* code path: own prevote in the polka set
     /\ lockedValue' = [lockedValue EXCEPT ![v] = x]
     /\ lockedRound' = [lockedRound EXCEPT ![v] = r]
     /\ validValue'  = [validValue EXCEPT ![v] = x]
@@ -153,13 +164,15 @@ PrecommitNil(v, r) ==
     /\ UNCHANGED <<round, validValue, validRound, decision, proposals,
                    prevotes>>
 
-\* Byzantine equivocation: a faulty validator may cast any precommit
-ByzantinePrecommit(v, r, x) ==
-    /\ v \in Byzantine
-    /\ precommits[r][v] = "none"
-    /\ precommits' = [precommits EXCEPT ![r][v] = x]
+\* a Byzantine proposer may broadcast any value (the wildcard vote
+\* model covers Byzantine VOTES; the proposal channel still needs an
+\* explicit adversarial action)
+ByzantinePropose(r, x) ==
+    /\ Proposer(r) \in Byzantine
+    /\ proposals[r] = Nil
+    /\ proposals' = [proposals EXCEPT ![r] = x]
     /\ UNCHANGED <<step, round, lockedValue, lockedRound, validValue,
-                   validRound, decision, proposals, prevotes>>
+                   validRound, decision, prevotes, precommits>>
 
 \* finalize_commit: 2/3 precommits for x decide it (any validator that
 \* observes the quorum, at any of its rounds — late deliveries included)
@@ -184,16 +197,15 @@ NextRound(v, r) ==
                    decision, proposals, prevotes, precommits>>
 
 Next ==
-    \/ \E v \in Validators, r \in Rounds : StartRound(v, r)
-    \/ \E v \in Validators, r \in Rounds, x \in Values \union {Nil} :
+    \/ \E v \in Correct, r \in Rounds : StartRound(v, r)
+    \/ \E v \in Correct, r \in Rounds, x \in Values \union {Nil} :
           DoPrevote(v, r, x)
-    \/ \E v \in Validators, r \in Rounds, x \in Values :
+    \/ \E v \in Correct, r \in Rounds, x \in Values :
           PrecommitValue(v, r, x)
-    \/ \E v \in Validators, r \in Rounds : PrecommitNil(v, r)
-    \/ \E v \in Byzantine, r \in Rounds, x \in Values \union {Nil} :
-          ByzantinePrecommit(v, r, x)
-    \/ \E v \in Validators, r \in Rounds, x \in Values : Decide(v, r, x)
-    \/ \E v \in Validators, r \in Rounds : NextRound(v, r)
+    \/ \E v \in Correct, r \in Rounds : PrecommitNil(v, r)
+    \/ \E r \in Rounds, x \in Values : ByzantinePropose(r, x)
+    \/ \E v \in Correct, r \in Rounds, x \in Values : Decide(v, r, x)
+    \/ \E v \in Correct, r \in Rounds : NextRound(v, r)
 
 Spec == Init /\ [][Next]_vars
 
@@ -222,6 +234,11 @@ DecisionPower ==
 
 \* TLC config suggestion:
 \*   Validators = {v1, v2, v3, v4};  Byzantine = {v4}
-\*   Values = {a, b};  MaxRound = 2
+\*   Values = {a, b};  MaxRound = 2;  SYMMETRY on Values
 \*   INVARIANTS Agreement ValidityLock DecisionPower
+\* No Java/TLC in the build environment: tools/check_spec.py is an
+\* explicit-state checker of EXACTLY this transition system (same
+\* actions and guards, same wildcard-Byzantine quorums, same
+\* round-robin Proposer) — exhaustive at n=4/f=1/|Values|=2 through
+\* MaxRound=3, run by tests/test_spec_check.py.
 ===============================================================================

@@ -1,0 +1,38 @@
+"""Machine-check the consensus spec (tools/check_spec.py — the TLC
+stand-in for spec/Consensus.tla; VERDICT r4 item 7).
+
+The full MaxRound=3 exhaustive run is exercised by the round's QA
+script; CI pins the fast configurations plus the self-test that proves
+the checker can actually detect violations."""
+
+import sys
+
+from tools.check_spec import Model, run
+
+
+def test_self_test_finds_violation():
+    # weakened quorum MUST produce an Agreement violation
+    model = Model(4, 1, 2, 1, quorum_delta=-1)
+    _n, err, _ex = run(model, progress=False)
+    assert err is not None and "Agreement" in err, err
+
+
+def test_exhaustive_maxround1():
+    model = Model(4, 1, 2, 1)
+    n_states, err, exhaustive = run(model, progress=False)
+    assert err is None, err
+    assert exhaustive
+    assert n_states > 10_000  # sanity: the search actually explored
+
+
+def test_rotation_covers_distinct_proposers():
+    m = Model(4, 1, 2, 3)
+    assert [m.proposer(r) for r in range(4)] == [0, 1, 2, 3]
+    # round 3's proposer is the Byzantine validator (index n-f..n-1):
+    # the model must explore byzantine-proposer rounds
+    assert m.proposer(3) >= m.correct
+
+
+def test_cli_self_test():
+    from tools.check_spec import main
+    assert main(["--self-test"]) == 0

