@@ -12,7 +12,7 @@ from .runner import Manifest
 VALIDATOR_CHOICES = [2, 3, 4, 5]
 TIMEOUT_COMMIT_CHOICES = [20, 50, 100, 250]
 DB_CHOICES = ["memdb", "filedb", "native"]
-INDEXER_CHOICES = ["kv", "kv", "null"]  # kv-weighted like the reference
+INDEXER_CHOICES = ["kv", "kv", "sqlite", "null"]  # kv-weighted like the reference
 
 
 def generate_manifests(seed: int = 1, n: int = 4) -> List[Manifest]:
