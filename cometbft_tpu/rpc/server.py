@@ -542,10 +542,27 @@ class Routes:
 class RPCServer:
     def __init__(self, env: Optional[RPCEnvironment],
                  host: str = "127.0.0.1", port: int = 0,
-                 methods: Optional[Dict[str, Callable]] = None):
+                 methods: Optional[Dict[str, Callable]] = None,
+                 max_body_bytes: int = 1_000_000,
+                 timeout_s: float = 10.0,
+                 cors_origins: str = "",
+                 cors_methods: str = "HEAD,GET,POST",
+                 cors_headers: str = "Origin,Accept,Content-Type,"
+                                     "X-Requested-With,X-Server-Time",
+                 tls_cert_file: str = "", tls_key_file: str = ""):
         """Default: the full route map over `env`. A custom `methods`
         dict serves the same JSON-RPC conventions over other backends
-        (the light proxy reuses this server with verified routes)."""
+        (the light proxy reuses this server with verified routes).
+
+        Hardening knobs mirror the reference's jsonrpc server config
+        (rpc/jsonrpc/server/http_server.go:56 + config.go RPCConfig):
+        request bodies over `max_body_bytes` are rejected before
+        reading; `timeout_s` bounds each connection's socket reads and
+        writes; CORS headers are emitted (and OPTIONS preflights
+        answered) only when `cors_origins` is configured; TLS serves
+        https when a cert/key pair is given."""
+        allowed_origins = [o.strip() for o in cors_origins.split(",")
+                           if o.strip()]
         if methods is None:
             routes = Routes(env)
             names = ["health", "status", "net_info", "genesis",
@@ -570,18 +587,64 @@ class RPCServer:
             # 1.0 status line); every JSON response sets Content-Length
             # so 1.1 keep-alive is safe
             protocol_version = "HTTP/1.1"
+            # socket read/write deadline: a client that stalls
+            # mid-request (slowloris) is disconnected, not held open
+            # (reference ReadTimeout/WriteTimeout)
+            timeout = timeout_s
 
             def log_message(self, *args):  # silence
                 pass
 
-            def _reply(self, payload: dict, rid=None):
+            def setup(self):
+                # TLS: the listening socket wraps with
+                # do_handshake_on_connect=False so accept() never
+                # handshakes — a client that connects and stalls must
+                # not block the accept loop (reference uses net/http,
+                # whose TLS handshake runs per-connection). The
+                # handshake happens HERE, in this connection's handler
+                # thread, bounded by the socket timeout setup() just
+                # applied.
+                super().setup()
+                if hasattr(self.connection, "do_handshake"):
+                    self.connection.do_handshake()
+
+            def _cors_origin(self) -> Optional[str]:
+                origin = self.headers.get("Origin")
+                if not origin or not allowed_origins:
+                    return None
+                if "*" in allowed_origins or origin in allowed_origins:
+                    return origin
+                return None
+
+            def _reply(self, payload: dict, rid=None, status=200):
                 body = json.dumps({"jsonrpc": "2.0", "id": rid,
                                    **payload}).encode()
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                origin = self._cors_origin()
+                if origin is not None:
+                    self.send_header("Access-Control-Allow-Origin",
+                                     origin)
+                    self.send_header("Vary", "Origin")
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_OPTIONS(self):
+                # CORS preflight (reference wraps the mux in
+                # github.com/rs/cors when CORSAllowedOrigins is set)
+                origin = self._cors_origin()
+                self.send_response(204 if origin else 403)
+                if origin is not None:
+                    self.send_header("Access-Control-Allow-Origin",
+                                     origin)
+                    self.send_header("Access-Control-Allow-Methods",
+                                     cors_methods)
+                    self.send_header("Access-Control-Allow-Headers",
+                                     cors_headers)
+                    self.send_header("Vary", "Origin")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             def _run(self, method: str, params: dict, rid):
                 fn = methods.get(method)
@@ -600,14 +663,38 @@ class RPCServer:
                                            "message": str(e)}}, rid)
 
             def do_POST(self):
-                ln = int(self.headers.get("Content-Length", "0"))
+                try:
+                    ln = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    ln = -1
+                if ln < 0 or ln > max_body_bytes:
+                    # cap BEFORE reading (reference MaxBytesReader via
+                    # maxBytesHandler, http_server.go:256): a declared
+                    # oversize/bogus length never allocates
+                    self._reply({"error": {
+                        "code": -32600,
+                        "message": f"request body exceeds "
+                                   f"{max_body_bytes} bytes"}},
+                        status=413)
+                    self.close_connection = True
+                    return
                 try:
                     req = json.loads(self.rfile.read(ln) or b"{}")
                 except json.JSONDecodeError:
                     self._reply({"error": {"code": -32700,
                                            "message": "parse error"}})
                     return
-                self._run(req.get("method", ""), req.get("params") or {},
+                if not isinstance(req, dict):
+                    self._reply({"error": {"code": -32600,
+                                           "message": "invalid request"}})
+                    return
+                params = req.get("params") or {}
+                if not isinstance(params, dict):
+                    self._reply({"error": {"code": -32602,
+                                           "message": "params must be "
+                                           "an object"}}, req.get("id"))
+                    return
+                self._run(str(req.get("method", "")), params,
                           req.get("id"))
 
             def do_GET(self):
@@ -627,6 +714,18 @@ class RPCServer:
                 self._run(method or "health", params, -1)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.tls = bool(tls_cert_file and tls_key_file)
+        if self.tls:
+            # https (reference http_server.go ServeTLS): wrap the
+            # listening socket; accepted conns handshake before HTTP
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            # handshake deferred to the per-connection handler thread
+            # (Handler.setup) — never in the accept loop
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.addr = self._httpd.server_address
         self._thread: Optional[threading.Thread] = None
 
