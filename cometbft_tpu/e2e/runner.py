@@ -38,8 +38,11 @@ class Manifest:
     # config-space knobs the generator randomizes (reference
     # test/e2e/generator randomizes database/abci/indexer choices)
     db_backend: str = "filedb"            # memdb | filedb | native
-    tx_indexer: str = "kv"                # kv | null
+    tx_indexer: str = "kv"                # kv | null | sqlite
     discard_abci_responses: bool = False
+    # 0 = library default; tiny values force WAL rotation within the
+    # first commits (crash-matrix coverage of the rotation windows)
+    wal_head_size_limit: int = 0
 
     @classmethod
     def from_toml(cls, text: str) -> "Manifest":
@@ -51,7 +54,9 @@ class Manifest:
                    db_backend=d.get("db_backend", "filedb"),
                    tx_indexer=d.get("tx_indexer", "kv"),
                    discard_abci_responses=bool(
-                       d.get("discard_abci_responses", False)))
+                       d.get("discard_abci_responses", False)),
+                   wal_head_size_limit=int(
+                       d.get("wal_head_size_limit", 0)))
 
 
 def _free_ports(n: int) -> List[int]:
@@ -88,6 +93,9 @@ class Testnet:
         self.manifest = manifest
         self.root = root
         self.nodes: List[NodeProc] = []
+        # env applied to every node process (perturbation knobs:
+        # ping/pong windows, p2p latency injection)
+        self.base_env: Dict[str, str] = {}
 
     # --- setup (runner/setup.go) ---------------------------------------------
 
@@ -125,6 +133,9 @@ class Testnet:
             cfg.tx_index.indexer = self.manifest.tx_indexer
             cfg.storage.discard_abci_responses = \
                 self.manifest.discard_abci_responses
+            if self.manifest.wal_head_size_limit > 0:
+                cfg.consensus.wal_head_size_limit = \
+                    self.manifest.wal_head_size_limit
             cfg.write()
 
     # --- lifecycle (runner/start.go) -----------------------------------------
@@ -132,6 +143,7 @@ class Testnet:
     def start_node(self, node: NodeProc,
                    extra_env: Optional[Dict[str, str]] = None) -> None:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(self.base_env)
         env.update(extra_env or {})
         log = open(node.log_path, "ab")
         node.proc = subprocess.Popen(
@@ -153,6 +165,45 @@ class Testnet:
             signal.SIGKILL if hard else signal.SIGTERM)
         node.proc.wait(timeout=30)
         node.proc = None
+
+    # --- perturbations (runner/perturb.go:16-80) ------------------------------
+    # The reference drives Docker (pause/unpause, network disconnect,
+    # tc-netem latency); the local-subprocess analogs:
+    #   pause      = SIGSTOP ... SIGCONT shorter than the p2p pong
+    #                timeout — peers keep their conns, node resumes
+    #   disconnect = SIGSTOP held past PONG_TIMEOUT so every peer tears
+    #                the conn down (p2p/mconn.py), then SIGCONT — the
+    #                node finds all conns dead and must redial through
+    #                the persistent-peer reconnect path
+    #   latency    = COMETBFT_TPU_P2P_LATENCY_MS env at node start
+    #                delays every outbound p2p packet (start_node
+    #                extra_env; see mconn._SEND_LATENCY_S)
+
+    def pause_node(self, node: NodeProc, secs: float = 3.0) -> None:
+        assert node.proc is not None
+        os.kill(node.proc.pid, signal.SIGSTOP)
+        try:
+            time.sleep(secs)
+        finally:
+            os.kill(node.proc.pid, signal.SIGCONT)
+
+    def disconnect_node(self, node: NodeProc,
+                        secs: Optional[float] = None) -> None:
+        """Partition one node from the net (freeze past the pong
+        timeout so every peer connection is torn down), then heal.
+
+        The default duration derives from the windows the NODE
+        processes actually run with — base_env overrides first, the
+        library defaults otherwise (the runner process's own imported
+        constants may differ from what base_env gave the nodes)."""
+        if secs is None:
+            from ..p2p import mconn
+            ping = float(self.base_env.get(
+                "COMETBFT_TPU_P2P_PING_INTERVAL_S", mconn.PING_INTERVAL))
+            pong = float(self.base_env.get(
+                "COMETBFT_TPU_P2P_PONG_TIMEOUT_S", mconn.PONG_TIMEOUT))
+            secs = ping + pong + 5.0
+        self.pause_node(node, secs)
 
     def stop(self) -> None:
         for node in self.nodes:

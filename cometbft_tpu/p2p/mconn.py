@@ -26,10 +26,25 @@ from typing import Callable, Dict, List, Optional
 
 from ..types import proto
 
+import os as _os
+
 MAX_PACKET_PAYLOAD = 1400          # connection.go defaultMaxPacketMsgPayloadSize
-PING_INTERVAL = 10.0
+PING_INTERVAL = float(_os.environ.get(
+    "COMETBFT_TPU_P2P_PING_INTERVAL_S", "10"))
+# a peer that stops answering pings is dead/partitioned — tear the
+# connection down so the switch can ban/redial (reference
+# connection.go:78 defaultPongTimeout=45s, scaled to our 10s pings).
+# Env-overridable so e2e perturbation tests can shrink the window.
+PONG_TIMEOUT = float(_os.environ.get(
+    "COMETBFT_TPU_P2P_PONG_TIMEOUT_S", "30"))
 DEFAULT_SEND_RATE = 5_120_000      # bytes/s, connection.go:725 SendRate
 DEFAULT_RECV_RATE = 5_120_000      # connection.go:726 RecvRate
+
+# e2e latency emulation (reference test/e2e/runner/perturb.go's docker
+# tc-netem analog): every outbound packet sleeps this long first. Test
+# knob only; 0/unset in production.
+_SEND_LATENCY_S = float(_os.environ.get(
+    "COMETBFT_TPU_P2P_LATENCY_MS", "0")) / 1e3
 _PKT_PING = 1
 _PKT_PONG = 2
 _PKT_MSG = 3
@@ -119,6 +134,8 @@ class MConnection:
         self._send_wake = threading.Event()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # pong deadline: set when a ping goes out, cleared by the pong
+        self._pong_deadline: Optional[float] = None
 
     def start(self) -> None:
         for fn, name in ((self._send_routine, "send"),
@@ -163,6 +180,13 @@ class MConnection:
         last_ping = time.monotonic()
         try:
             while not self._stop.is_set():
+                # snapshot: the recv routine clears this to None on
+                # pong arrival concurrently
+                deadline = self._pong_deadline
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"pong timeout ({PONG_TIMEOUT:.0f}s) — peer "
+                        f"dead or partitioned")
                 ch = self._pick_channel()
                 if ch is None:
                     if self._send_wake.wait(timeout=1.0):
@@ -170,9 +194,14 @@ class MConnection:
                     if time.monotonic() - last_ping > PING_INTERVAL:
                         self._conn.send_message(bytes([_PKT_PING]))
                         last_ping = time.monotonic()
+                        if self._pong_deadline is None:
+                            self._pong_deadline = \
+                                time.monotonic() + PONG_TIMEOUT
                     continue
                 pkt = ch.next_packet()
                 if pkt is not None:
+                    if _SEND_LATENCY_S > 0:
+                        time.sleep(_SEND_LATENCY_S)
                     self._send_monitor.limit(len(pkt))
                     self._conn.send_message(pkt)
                 # decay so bursts don't permanently deprioritize
@@ -197,6 +226,7 @@ class MConnection:
                     self._conn.send_message(bytes([_PKT_PONG]))
                     continue
                 if kind == _PKT_PONG:
+                    self._pong_deadline = None
                     continue
                 if kind != _PKT_MSG:
                     raise ConnectionError(f"unknown packet kind {kind}")

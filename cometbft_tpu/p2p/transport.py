@@ -13,6 +13,7 @@ from typing import Callable, List, Optional, Tuple
 from ..crypto.keys import Ed25519PrivKey
 from ..types import proto
 from .conn import SecretConnection, HandshakeError
+from .mconn import PONG_TIMEOUT
 
 
 @dataclass
@@ -112,7 +113,14 @@ class Transport:
             err = self.node_info.compatible_with(peer_info)
             if err is not None:
                 raise HandshakeError(err)
-            raw.settimeout(None)
+            # post-handshake: a finite socket timeout instead of
+            # blocking forever. Pings flow every PING_INTERVAL (10s)
+            # both ways, so an alive peer always produces traffic well
+            # inside this window; a frozen/partitioned peer trips
+            # socket.timeout (an OSError) in whichever routine is
+            # stuck — including a sendall blocked on a full TCP buffer,
+            # which the mconn-level pong deadline alone cannot catch
+            raw.settimeout(2 * PONG_TIMEOUT)
             on_conn(sc, peer_info, outbound)
         except (HandshakeError, ConnectionError, OSError, ValueError):
             try:
