@@ -1,0 +1,37 @@
+#!/bin/bash
+# TPU tunnel watcher: probe the single-client axon tunnel every ~4 min
+# in a throwaway subprocess; the moment it answers, run the highest-value
+# measurements IMMEDIATELY (the alive window can be short):
+#   1. bench.py           -> BENCH_r05_live.json   (headline number)
+#   2. tools/ab_pallas.py -> docs/ab_r05.log       (XLA vs pallas A/B)
+# All measurement runs are strictly sequential — the tunnel is
+# single-client; a second concurrent process blocks forever and killing
+# it can wedge the server side for hours (docs/PERF.md).
+set -u
+cd /root/repo
+LOG=/root/repo/tunnel_watch.log
+echo "$(date -u +%F' '%H:%M:%S) watcher start" >> "$LOG"
+for i in $(seq 1 200); do
+  out=$(timeout 75 python -c "
+import sys; sys.path.insert(0, '/root/repo')
+from cometbft_tpu.libs.jax_cache import enable_compile_cache
+enable_compile_cache()
+import jax
+print('ALIVE', jax.devices()[0].platform, flush=True)
+" 2>/dev/null)
+  if echo "$out" | grep -q ALIVE; then
+    echo "$(date -u +%F' '%H:%M:%S) tunnel ALIVE ($out) — measuring" >> "$LOG"
+    BENCH_TOTAL_TIMEOUT=3600 timeout 3900 python bench.py \
+      > /root/repo/BENCH_r05_live.json 2>> "$LOG"
+    rc=$?
+    echo "$(date -u +%F' '%H:%M:%S) bench rc=$rc: $(cat /root/repo/BENCH_r05_live.json)" >> "$LOG"
+    AB_N=8192 timeout 2700 python tools/ab_pallas.py \
+      > /root/repo/docs/ab_r05.log 2>&1
+    echo "$(date -u +%F' '%H:%M:%S) ab_pallas rc=$? — watcher done" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date -u +%F' '%H:%M:%S) probe $i: wedged" >> "$LOG"
+  sleep 240
+done
+echo "$(date -u +%F' '%H:%M:%S) watcher gave up (no revival)" >> "$LOG"
+exit 1
