@@ -49,6 +49,31 @@ class RPCConfig:
     # operators to expose RPC publicly, and these routes let any caller
     # flush the mempool or steer peering)
     unsafe: bool = False
+    # server hardening (reference config.go RPCConfig +
+    # rpc/jsonrpc/server/http_server.go:56 DefaultConfig):
+    # CORS (empty = no CORS headers; "*" or csv of allowed origins)
+    cors_allowed_origins: str = ""
+    cors_allowed_methods: str = "HEAD,GET,POST"
+    cors_allowed_headers: str = ("Origin,Accept,Content-Type,"
+                                 "X-Requested-With,X-Server-Time")
+    # request-body cap (reference MaxBodyBytes = 1MB) and per-connection
+    # read/write timeout (reference ReadTimeout/WriteTimeout = 10s)
+    max_body_bytes: int = 1_000_000
+    timeout_ms: int = 10_000
+    # TLS: both set -> serve https (reference TLSCertFile/TLSKeyFile)
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+
+    def validate_basic(self) -> None:
+        """reference config.go RPCConfig.ValidateBasic."""
+        if self.max_body_bytes <= 0:
+            raise ValueError("rpc.max_body_bytes must be positive")
+        if self.timeout_ms <= 0:
+            raise ValueError("rpc.timeout_ms must be positive")
+        if bool(self.tls_cert_file) != bool(self.tls_key_file):
+            raise ValueError(
+                "rpc.tls_cert_file and rpc.tls_key_file must be set "
+                "together")
 
 
 @dataclass
@@ -74,6 +99,11 @@ class ConsensusTimeoutsConfig:
     # config.go SkipTimeoutCommit)
     skip_timeout_commit: bool = True
     wal_file: str = "data/cs.wal"
+    # autofile.Group rotation (reference internal/autofile/group.go
+    # defaults: 10MB head / 1GB group): the head rotates to wal.NNN at
+    # this size, and the oldest rotated files are pruned past the total
+    wal_head_size_limit: int = 8 << 20
+    wal_total_size_limit: int = 1 << 30
 
 
 @dataclass
@@ -135,10 +165,10 @@ class StorageConfig:
 @dataclass
 class TxIndexConfig:
     """reference config/config.go TxIndexConfig."""
-    indexer: str = "kv"                    # "kv" | "null"
+    indexer: str = "kv"                    # "kv" | "null" | "sqlite"
 
     def validate_basic(self) -> None:
-        if self.indexer not in ("kv", "null"):
+        if self.indexer not in ("kv", "null", "sqlite"):
             raise ValueError(f"unknown indexer {self.indexer!r}")
 
 
@@ -206,6 +236,7 @@ class Config:
                      "timeout_precommit", "timeout_commit"):
             if getattr(self.consensus, name) < 0:
                 raise ValueError(f"negative {name}")
+        self.rpc.validate_basic()
         self.statesync.validate_basic()
         self.blocksync.validate_basic()
         self.storage.validate_basic()

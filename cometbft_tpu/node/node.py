@@ -140,8 +140,15 @@ class Node:
 
         # --- event bus + indexers (node.go:328-334) --------------------------
         self.event_bus = EventBus()
-        self.tx_indexer = TxIndexer(self._indexer_db)
-        self.block_indexer = BlockIndexer(self._indexer_db)
+        if config.tx_index.indexer == "sqlite":
+            # relational sink (reference psql sink's role,
+            # state/indexer/sink/psql): same interface, sqlite file
+            from ..indexer.sqlite import open_sqlite_indexers
+            self.tx_indexer, self.block_indexer = open_sqlite_indexers(
+                config.path(config.base.db_dir))
+        else:
+            self.tx_indexer = TxIndexer(self._indexer_db)
+            self.block_indexer = BlockIndexer(self._indexer_db)
         self.indexer_service = IndexerService(
             self.tx_indexer, self.block_indexer, self.event_bus)
 
@@ -174,6 +181,18 @@ class Node:
         self.executor.pruner = self.pruner
         from ..libs.metrics import ConsensusMetrics, Registry
         self.metrics_registry = Registry()
+        # mosaic-miscompile canary counters (ops/ed25519._run_canary):
+        # trips > 0 means a pallas kernel claimed batch_ok on a batch
+        # with a known-invalid lane and was permanently disabled
+        from ..ops.ed25519 import canary_stats
+        self.metrics_registry.callback_gauge(
+            "crypto_pallas_canary_runs",
+            "Tampered-lane canary executions against the pallas kernel",
+            fn=lambda: canary_stats()["runs"])
+        self.metrics_registry.callback_gauge(
+            "crypto_pallas_canary_trips",
+            "Silent-accept miscompiles caught (pallas then disabled)",
+            fn=lambda: canary_stats()["trips"])
         cc = config.consensus
         self.consensus = ConsensusState(
             ConsensusConfig(
@@ -188,7 +207,9 @@ class Node:
                 skip_timeout_commit=cc.skip_timeout_commit),
             state, self.executor, self.block_store,
             priv_validator=self.priv_validator,
-            wal=WAL(config.path(cc.wal_file)),
+            wal=WAL(config.path(cc.wal_file),
+                    head_size_limit=cc.wal_head_size_limit,
+                    total_size_limit=cc.wal_total_size_limit),
             name=config.base.moniker,
             metrics=ConsensusMetrics(self.metrics_registry))
         self.consensus.evidence_pool = self.evidence_pool
@@ -237,7 +258,18 @@ class Node:
         self.rpc_server: Optional[RPCServer] = None
         if config.rpc.enable:
             host, port = self._split_addr(config.rpc.laddr)
-            self.rpc_server = RPCServer(self.rpc_env, host, port)
+            rc = config.rpc
+            self.rpc_server = RPCServer(
+                self.rpc_env, host, port,
+                max_body_bytes=rc.max_body_bytes,
+                timeout_s=rc.timeout_ms / 1000.0,
+                cors_origins=rc.cors_allowed_origins,
+                cors_methods=rc.cors_allowed_methods,
+                cors_headers=rc.cors_allowed_headers,
+                tls_cert_file=config.path(rc.tls_cert_file)
+                if rc.tls_cert_file else "",
+                tls_key_file=config.path(rc.tls_key_file)
+                if rc.tls_key_file else "")
 
         # --- companion gRPC services (node.go:805-845) -----------------------
         self.grpc_services = None
