@@ -198,4 +198,9 @@ def pubkey_from_type_bytes(key_type: str, raw: bytes) -> PubKey:
     if key_type == "sr25519":
         from .sr25519 import Sr25519PubKey
         return Sr25519PubKey(raw)
+    if key_type == "bls12_381":
+        # pure-Python curve (reference gates this type behind a blst
+        # build tag, crypto/bls12381/key_bls12381.go:1)
+        from .bls12381 import Bls12381PubKey
+        return Bls12381PubKey(raw)
     raise ValueError(f"unknown key type {key_type!r}")

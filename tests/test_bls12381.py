@@ -1,0 +1,119 @@
+"""BLS12-381 key type (crypto/bls12381.py; reference
+crypto/bls12381/key_bls12381.go + const.go, there gated behind a blst
+build tag — here a from-scratch pure implementation).
+
+Soundness is pinned structurally: derived parameter identities, group
+orders, untwist lands on E(Fq12), pairing bilinearity/non-degeneracy/
+r-torsion, ZCash serialization round-trips with the canonical G1
+generator bytes, and the sign/verify matrix. Pairing calls cost ~1s
+each in pure Python, so the heavy checks run once at module scope."""
+
+import pytest
+
+from cometbft_tpu.crypto import bls12381 as b
+from cometbft_tpu.crypto.keys import pubkey_from_type_bytes
+
+# the universally published compressed G1 generator — pins the ZCash
+# bit convention and big-endian layout against external truth
+G1_GEN_COMPRESSED = bytes.fromhex(
+    "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+    "6c55e83ff97a1aeffb3af00adb22c6bb")
+
+
+def test_parameter_identities():
+    x = b.X_PARAM
+    assert b.R == x**4 - x**2 + 1
+    assert b.P == (x - 1) ** 2 // 3 * b.R + x
+    assert b.H1 == (x - 1) ** 2 // 3
+    assert b._N2 % b.R == 0 and b.H2 == b._N2 // b.R
+
+
+def test_generators_and_orders():
+    assert b._fq.on_curve(b.G1_GEN)
+    assert b._fq2.on_curve(b.G2_GEN)
+    assert b._fq.pt_mul(b.R, b.G1_GEN) is None
+    assert b._fq2.pt_mul(b.R, b.G2_GEN) is None
+    assert b._fq12.on_curve(b._untwist(b.G2_GEN))
+
+
+def test_serialization_and_canonical_generator():
+    assert b.g1_compress(b.G1_GEN) == G1_GEN_COMPRESSED
+    assert b.g1_decompress(G1_GEN_COMPRESSED) == b.G1_GEN
+    sig_pt = b._fq2.pt_mul(12345, b.G2_GEN)
+    enc = b.g2_compress(sig_pt)
+    assert len(enc) == 96 and b.g2_decompress(enc) == sig_pt
+    # infinity encodings
+    assert b.g1_compress(None)[0] == 0xC0
+    assert b.g1_decompress(b.g1_compress(None)) is None
+    # rejects: not-compressed flag, x >= p, off-curve x
+    with pytest.raises(ValueError):
+        b.g1_decompress(bytes(48))
+    with pytest.raises(ValueError):
+        b.g1_decompress(bytes([0x80]) + b"\xff" * 47)
+
+
+def test_hash_to_g2_deterministic_and_in_subgroup():
+    h1 = b.hash_to_g2(b"msg-a".ljust(32, b"\x00"))
+    h2 = b.hash_to_g2(b"msg-a".ljust(32, b"\x00"))
+    h3 = b.hash_to_g2(b"msg-b".ljust(32, b"\x00"))
+    assert h1 == h2 and h1 != h3
+    assert b._fq2.on_curve(h1)
+    assert b._fq2.pt_mul(b.R, h1) is None  # cofactor cleared
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    sk = b.Bls12381PrivKey.generate(seed=b"bls-test-key")
+    return sk, sk.pub_key()
+
+
+def test_sign_verify_matrix(keypair):
+    sk, pk = keypair
+    assert len(pk.bytes_()) == b.PUB_KEY_SIZE
+    assert pk.type_() == "bls12_381" == sk.type_()
+    assert len(pk.address()) == 20
+    msg = b"cometbft-tpu bls"
+    sig = sk.sign(msg)
+    assert len(sig) == b.SIGNATURE_LENGTH
+    assert pk.verify_signature(msg, sig)
+    assert not pk.verify_signature(b"other", sig)
+    tam = sig[:50] + bytes([sig[50] ^ 1]) + sig[51:]
+    assert not pk.verify_signature(msg, tam)
+    other = b.Bls12381PrivKey.generate(seed=b"other-key").pub_key()
+    assert not other.verify_signature(msg, sig)
+    # malformed signatures are rejected, not raised
+    assert not pk.verify_signature(msg, b"\x00" * 96)
+    assert not pk.verify_signature(msg, b"")
+
+
+def test_long_message_hashes_first(keypair):
+    """key_bls12381.go:90: msg > 32 bytes signs sha256(msg) — so the
+    signature over the long message equals the signature over its
+    hash."""
+    import hashlib
+    sk, pk = keypair
+    long = b"z" * 100
+    sig = sk.sign(long)
+    assert sig == sk.sign(hashlib.sha256(long).digest())
+    assert pk.verify_signature(long, sig)
+
+
+def test_privkey_range_rejected():
+    """blst's SecretKeyFromBytes (key_bls12381.go:44) rejects scalars
+    outside [1, r-1]; the same key file must fail identically here —
+    never silently reduce mod r."""
+    with pytest.raises(ValueError):
+        b.Bls12381PrivKey(bytes(32))                       # zero
+    with pytest.raises(ValueError):
+        b.Bls12381PrivKey(b.R.to_bytes(32, "big"))         # == r
+    with pytest.raises(ValueError):
+        b.Bls12381PrivKey(b"\xff" * 32)                    # > r
+    b.Bls12381PrivKey((b.R - 1).to_bytes(32, "big"))       # r-1 ok
+
+
+def test_key_factory_roundtrip(keypair):
+    _sk, pk = keypair
+    got = pubkey_from_type_bytes("bls12_381", pk.bytes_())
+    assert got.bytes_() == pk.bytes_()
+    with pytest.raises(ValueError):
+        pubkey_from_type_bytes("bls12_381", b"\x00" * 48)
