@@ -15,6 +15,28 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def raise_compiler_stack_limit() -> None:
+    """Root-cause mitigation for the XLA:CPU SIGSEGV at batch >= 256
+    (docs/PERF.md "known compile hazard"): XLA's HLO passes recurse
+    deeply on the RLC kernel graph and OVERFLOW the default 8MB
+    pthread stack (observed: SIGSEGV at the stack guard page inside
+    libjax_common). pthreads size their stacks from RLIMIT_STACK at
+    thread creation, so raising the soft limit BEFORE the compiler
+    thread pool exists removes the crash. Called from
+    enable_compile_cache so every entry point gets it; a no-op when
+    the limit is already high or the pool already exists."""
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
+        want = 512 * 1024 * 1024
+        if hard != resource.RLIM_INFINITY:
+            want = min(want, hard)
+        if soft != resource.RLIM_INFINITY and soft < want:
+            resource.setrlimit(resource.RLIMIT_STACK, (want, hard))
+    except (ImportError, ValueError, OSError):  # pragma: no cover
+        pass
+
+
 def first_configured_platform() -> str:
     """First entry of jax.config.jax_platforms WITHOUT initializing a
     backend ("" when undetermined). The shared device-vs-cpu sniff:
@@ -35,6 +57,7 @@ def is_device_platform() -> bool:
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
+    raise_compiler_stack_limit()
     import jax
     # the ambient TPU-tunnel setup pins jax_platforms programmatically
     # (to "axon,cpu"), which BEATS the JAX_PLATFORMS env var — so a

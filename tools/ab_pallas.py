@@ -1,7 +1,31 @@
 """A/B on the real chip: XLA point ops vs the pallas kernels, then the
-full RLC verify both ways at batch 8192."""
-import os, sys, time
-sys.path.insert(0, "/root/repo")
+full RLC verify both ways at batch 8192.
+
+AB_SWEEP="256,512,1024" re-execs this script once per TILE value (the
+pallas lane-tile is latched at module import, so each point needs a
+fresh interpreter) timing ONLY the full pallas RLC — the TILE tuning
+pass of VERDICT r5 item 3. AB_ONLY=pallas skips the per-stage A/B."""
+import os, subprocess, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("AB_SWEEP"):
+    tiles = [int(t) for t in os.environ["AB_SWEEP"].split(",")]
+    print(f"TILE sweep: {tiles}", flush=True)
+    for tile in tiles:
+        env = dict(os.environ, COMETBFT_TPU_PALLAS_TILE=str(tile),
+                   AB_ONLY="pallas")
+        env.pop("AB_SWEEP")
+        print(f"--- TILE={tile} ---", flush=True)
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, timeout=2400)
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            # one hung tile (wedged tunnel mid-run) must not abort the
+            # remaining sweep points
+            rc = "timeout"
+        print(f"--- TILE={tile} rc={rc} ---", flush=True)
+    sys.exit(0)
 from cometbft_tpu.libs.jax_cache import enable_compile_cache
 enable_compile_cache()
 import numpy as np
@@ -31,30 +55,36 @@ def t(name, fn, *args, reps=5):
     print(f"{name:34s} {best*1e3:9.3f} ms", flush=True)
     return out
 
-pt = (limbs(N), limbs(N), limbs(N), limbs(N))
-packed = jnp.stack(pt)
+_only_pallas = os.environ.get("AB_ONLY") == "pallas"
+print(f"pallas TILE={pv.TILE}", flush=True)
 
-# 1) pt_add: XLA vs pallas
-t("pt_add XLA", jax.jit(ed.pt_add), pt, pt)
-t("pt_add PALLAS tiled", lambda p, q: pv.pt_add_tiled(p, q), packed, packed)
+if not _only_pallas:
+    pt = (limbs(N), limbs(N), limbs(N), limbs(N))
+    packed = jnp.stack(pt)
 
-# 2) window stage: XLA table+lookup+tree vs pallas fused
-tdig = jnp.asarray(rng.integers(0, 16, size=(64, N), dtype=np.int32))
-zdig = jnp.asarray(rng.integers(0, 16, size=(32, N), dtype=np.int32))
+    # 1) pt_add: XLA vs pallas
+    t("pt_add XLA", jax.jit(ed.pt_add), pt, pt)
+    t("pt_add PALLAS tiled", lambda p, q: pv.pt_add_tiled(p, q),
+      packed, packed)
 
-@jax.jit
-def xla_stage(a, r, td, zd):
-    wa = ed.pt_tree_sum(ed.lookup_windows(ed.window_table(a), td))
-    wr = ed.pt_tree_sum(ed.lookup_windows(ed.window_table(r), zd))
-    return wa[0] + wr[0]
-t("window stage XLA", xla_stage, pt, pt, tdig, zdig)
+    # 2) window stage: XLA table+lookup+tree vs pallas fused
+    tdig = jnp.asarray(rng.integers(0, 16, size=(64, N), dtype=np.int32))
+    zdig = jnp.asarray(rng.integers(0, 16, size=(32, N), dtype=np.int32))
 
-def pallas_stage(a, r, td, zd):
-    out = pv.rlc_window_sums(a, r, td, zd)
-    folded = jnp.transpose(out, (2, 3, 1, 0, 4)).reshape(
-        4, 16, 96, out.shape[0] * pv.TAIL)
-    return ed.pt_tree_sum(tuple(folded[i] for i in range(4)))[0]
-t("window stage PALLAS", jax.jit(pallas_stage), packed, packed, tdig, zdig)
+    @jax.jit
+    def xla_stage(a, r, td, zd):
+        wa = ed.pt_tree_sum(ed.lookup_windows(ed.window_table(a), td))
+        wr = ed.pt_tree_sum(ed.lookup_windows(ed.window_table(r), zd))
+        return wa[0] + wr[0]
+    t("window stage XLA", xla_stage, pt, pt, tdig, zdig)
+
+    def pallas_stage(a, r, td, zd):
+        out = pv.rlc_window_sums(a, r, td, zd)
+        folded = jnp.transpose(out, (2, 3, 1, 0, 4)).reshape(
+            4, 16, 96, out.shape[0] * pv.TAIL)
+        return ed.pt_tree_sum(tuple(folded[i] for i in range(4)))[0]
+    t("window stage PALLAS", jax.jit(pallas_stage), packed, packed,
+      tdig, zdig)
 
 # 3) full RLC verify both ways on real signatures
 from cometbft_tpu.ops.ed25519 import (
@@ -91,10 +121,12 @@ def full(kern, name):
     assert bool(bok) and np.asarray(sok).all(), name
 
 full(verify_rlc_kernel_pallas, "PALLAS")
-full(verify_rlc_kernel, "XLA")
-sps = None
-for name, kern in (("PALLAS", verify_rlc_kernel_pallas),
-                   ("XLA", verify_rlc_kernel)):
+if not _only_pallas:
+    full(verify_rlc_kernel, "XLA")
+variants = [("PALLAS", verify_rlc_kernel_pallas)]
+if not _only_pallas:
+    variants.append(("XLA", verify_rlc_kernel))
+for name, kern in variants:
     t0 = time.perf_counter()
     iters = 4
     for _ in range(iters):

@@ -1,0 +1,102 @@
+"""Saturation sweep: find this build's tx/s knee the way the reference
+QA process does (docs/references/qa/method.md: escalate load until the
+net stops keeping up; the v1 baseline saturates at c=1,r=400 ≈ 400 tx/s
+on a 200-node DigitalOcean testnet).
+
+Starts a local e2e testnet (OS processes over TCP), then runs
+tools/loadtime.py rate steps against it, recording delivered tx/s and
+latency per step. A step "saturates" when commits or delivered rate
+drop below 80% of offered, or p90 latency exceeds the latency budget.
+Writes a JSON report and a markdown row for docs/PERF.md.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/saturation.py \
+        [--validators 4] [--rates 25,50,100,200,400] [--duration 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.e2e.runner import Manifest, Testnet  # noqa: E402
+from tools import loadtime  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", type=int, default=4)
+    ap.add_argument("--rates", default="25,50,100,200,400")
+    ap.add_argument("--duration", type=float, default=20.0)
+    ap.add_argument("--latency-budget", type=float, default=8.0,
+                    help="p90 commit-latency ceiling, seconds (the QA "
+                         "baseline saw peaks of 8s at its knee)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    rates = [float(r) for r in args.rates.split(",")]
+    root = tempfile.mkdtemp(prefix="saturation-")
+    net = Testnet(Manifest(chain_id="sat-net",
+                           validators=args.validators,
+                           timeout_commit_ms=200), root)
+    print(f"[saturation] starting {args.validators}-validator net "
+          f"under {root}...", file=sys.stderr, flush=True)
+    net.setup()
+    net.start()
+    steps = []
+    try:
+        net.wait_for_height(2, timeout=300)
+        host, port = "127.0.0.1", net.nodes[0].rpc_port
+        for rate in rates:
+            print(f"[saturation] step: {rate} tx/s for "
+                  f"{args.duration}s...", file=sys.stderr, flush=True)
+            rep = loadtime.run(host, port, rate, args.duration,
+                               connections=2)
+            delivered = rep["throughput_tx_s"]
+            p90 = rep["latency_p90_s"]
+            lost = rep["txs_sent"] - rep["txs_committed"]
+            sat = (rep["txs_committed"] < 0.8 * rep["txs_sent"]
+                   or delivered < 0.8 * rate
+                   or p90 > args.latency_budget)
+            steps.append({"offered_tx_per_sec": rate,
+                          "delivered_tx_per_sec": delivered,
+                          "latency_p50_s": rep["latency_p50_s"],
+                          "latency_p90_s": p90,
+                          "committed": rep["txs_committed"],
+                          "sent": rep["txs_sent"],
+                          "lost": lost,
+                          "saturated": sat})
+            print(f"[saturation]   delivered {delivered:.1f} tx/s, "
+                  f"p90 {p90}s, lost {lost}, saturated={sat}",
+                  file=sys.stderr, flush=True)
+            if sat:
+                break
+    finally:
+        net.stop()
+
+    knee = next((s for s in steps if s["saturated"]), None)
+    best = max((s["delivered_tx_per_sec"] for s in steps), default=0.0)
+    report = {
+        "metric": "tx_saturation",
+        "validators": args.validators,
+        "best_delivered_tx_per_sec": round(best, 1),
+        "knee_offered_tx_per_sec":
+            knee["offered_tx_per_sec"] if knee else None,
+        "steps": steps,
+        "reference_baseline":
+            "~400 tx/s on 200 DigitalOcean nodes (QA v1)",
+        "hardware": "all validators + load generator on one local box",
+    }
+    print(json.dumps(report if args.json else
+                     {k: v for k, v in report.items() if k != "steps"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,8 @@
 # measurements IMMEDIATELY (the alive window can be short):
 #   1. bench.py           -> BENCH_r05_live.json   (headline number)
 #   2. tools/ab_pallas.py -> docs/ab_r05.log       (XLA vs pallas A/B)
+#   3. TILE sweep         -> docs/ab_r05_sweep.log (256/1024/2048)
+# Worst-case hold time once alive: ~1h bench + ~45min A/B + ~2h sweep.
 # All measurement runs are strictly sequential — the tunnel is
 # single-client; a second concurrent process blocks forever and killing
 # it can wedge the server side for hours (docs/PERF.md).
@@ -27,7 +29,10 @@ print('ALIVE', jax.devices()[0].platform, flush=True)
     echo "$(date -u +%F' '%H:%M:%S) bench rc=$rc: $(cat /root/repo/BENCH_r05_live.json)" >> "$LOG"
     AB_N=8192 timeout 2700 python tools/ab_pallas.py \
       > /root/repo/docs/ab_r05.log 2>&1
-    echo "$(date -u +%F' '%H:%M:%S) ab_pallas rc=$? — watcher done" >> "$LOG"
+    echo "$(date -u +%F' '%H:%M:%S) ab_pallas rc=$?" >> "$LOG"
+    AB_N=8192 AB_SWEEP=256,1024,2048 timeout 7500 python tools/ab_pallas.py \
+      > /root/repo/docs/ab_r05_sweep.log 2>&1
+    echo "$(date -u +%F' '%H:%M:%S) tile sweep rc=$? — watcher done" >> "$LOG"
     exit 0
   fi
   echo "$(date -u +%F' '%H:%M:%S) probe $i: wedged" >> "$LOG"
