@@ -9,7 +9,11 @@ from typing import List
 
 from .runner import Manifest
 
-VALIDATOR_CHOICES = [2, 3, 4, 5]
+# small nets dominate (each validator is an OS process on shared CI
+# cores) with an occasional 8-validator draw; the fixed scale tests
+# (tests/test_cluster_scale.py) cover 20-validator in-process nets and
+# the 175-validator QA valset through blocksync
+VALIDATOR_CHOICES = [2, 3, 4, 4, 5, 5, 8]
 TIMEOUT_COMMIT_CHOICES = [20, 50, 100, 250]
 DB_CHOICES = ["memdb", "filedb", "native"]
 INDEXER_CHOICES = ["kv", "kv", "sqlite", "null"]  # kv-weighted like the reference

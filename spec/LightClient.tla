@@ -1,0 +1,107 @@
+---------------------------- MODULE LightClient ----------------------------
+(***************************************************************************)
+(* Light-client verification safety, written against                      *)
+(* cometbft_tpu/light/verifier.py (reference artifact:                    *)
+(* spec/light-client/verification/ in CometBFT).                          *)
+(*                                                                        *)
+(* The light client holds a TRUSTED header and accepts an untrusted       *)
+(* header by one of two rules (verifier.py:67-130):                       *)
+(*   adjacent:      the untrusted valset IS the trusted header's          *)
+(*                  next-valset (hash-bound) and > 2/3 of it signed;      *)
+(*   non-adjacent:  signers hold > 1/3 of the TRUSTED valset's power     *)
+(*                  (verify_commit_light_trusting, validation.py:179)     *)
+(*                  AND > 2/3 of the header's OWN claimed valset signed.  *)
+(*                                                                        *)
+(* Adversary: a fixed faulty set F signs anything; honest validators      *)
+(* sign only the canonical header of each height.  Fault assumption:     *)
+(* F holds strictly less than 1/3 of every canonical valset inside the   *)
+(* trusting period (the premise of the skipping rule).                   *)
+(*                                                                        *)
+(* Safety: every header the client accepts is canonical.                 *)
+(*                                                                        *)
+(* Machine-checked by tools/check_light_spec.py — an explicit-state      *)
+(* enumeration of EXACTLY this transition system (no TLC in the build    *)
+(* image): all canonical chains over the valset family x all faulty      *)
+(* sets satisfying the assumption x all reachable trusted states x all   *)
+(* forged (claimed-valset, signer-subset) headers.  With                 *)
+(* --n 5 --heights 4 --min-valset 2: 340,650 configs, no forgery         *)
+(* accepted; --self-test drops the fault assumption and exhibits the     *)
+(* classic claimed-valset forgery.                                       *)
+(***************************************************************************)
+
+EXTENDS Integers, FiniteSets
+
+CONSTANTS
+    Validators,     \* universe of validator identities (equal power)
+    Faulty,         \* the adversary's validators
+    Heights,        \* 1..H canonical chain heights
+    Chain           \* [Heights -> SUBSET Validators]: canonical valsets
+
+ASSUME Faulty \subseteq Validators
+\* fault assumption: < 1/3 of every canonical valset is faulty
+ASSUME \A h \in Heights :
+    3 * Cardinality(Faulty \cap Chain[h]) < Cardinality(Chain[h])
+
+(***************************************************************************)
+(* The implementation's two threshold predicates (floor division          *)
+(* matches validation.py:192 `needed = total * num // den` with the       *)
+(* strict `tallied > needed` core).                                       *)
+(***************************************************************************)
+TrustingOK(S, T) ==
+    3 * Cardinality(S \cap T) > Cardinality(T)
+
+OwnCommitOK(S, W) ==
+    /\ S \subseteq W
+    /\ 3 * Cardinality(S) > 2 * Cardinality(W)
+
+(***************************************************************************)
+(* Headers presentable at height h: the canonical one (anyone in          *)
+(* Chain[h] may appear as a signer) or a forgery (only Faulty sign).      *)
+(* A forged ADJACENT header is hash-bound to the real next valset; a     *)
+(* forged SKIPPING header claims any valset W.                           *)
+(***************************************************************************)
+
+VARIABLES trustedHeight, accepted   \* accepted: set of (height, canon?)
+
+Init ==
+    /\ trustedHeight = 1
+    /\ accepted = {<<1, TRUE>>}
+
+AcceptCanonical(h) ==
+    /\ h \in Heights /\ h > trustedHeight
+    /\ LET S == Chain[h] IN
+       IF h = trustedHeight + 1
+       THEN OwnCommitOK(S, Chain[h])
+       ELSE /\ TrustingOK(S, Chain[trustedHeight])
+            /\ OwnCommitOK(S, Chain[h])
+    /\ trustedHeight' = h
+    /\ accepted' = accepted \union {<<h, TRUE>>}
+
+AcceptForgedAdjacent(h, S) ==
+    /\ h = trustedHeight + 1 /\ h \in Heights
+    /\ S \subseteq Faulty
+    /\ OwnCommitOK(S, Chain[h])      \* hash-bound claimed set
+    /\ accepted' = accepted \union {<<h, FALSE>>}
+    /\ UNCHANGED trustedHeight
+
+AcceptForgedSkipping(h, W, S) ==
+    /\ h \in Heights /\ h > trustedHeight + 1
+    /\ S \subseteq Faulty /\ W \subseteq Validators
+    /\ TrustingOK(S, Chain[trustedHeight])
+    /\ OwnCommitOK(S, W)
+    /\ accepted' = accepted \union {<<h, FALSE>>}
+    /\ UNCHANGED trustedHeight
+
+Next ==
+    \/ \E h \in Heights : AcceptCanonical(h)
+    \/ \E h \in Heights, S \in SUBSET Faulty :
+          AcceptForgedAdjacent(h, S)
+    \/ \E h \in Heights, W \in SUBSET Validators,
+         S \in SUBSET Faulty : AcceptForgedSkipping(h, W, S)
+
+Spec == Init /\ [][Next]_<<trustedHeight, accepted>>
+
+\* Safety: nothing non-canonical is ever accepted
+Invariant == \A a \in accepted : a[2] = TRUE
+
+=============================================================================

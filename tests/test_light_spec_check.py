@@ -1,0 +1,52 @@
+"""Machine-check the light-client verification spec
+(tools/check_light_spec.py ↔ spec/LightClient.tla; reference artifact
+spec/light-client/verification/)."""
+
+from tools.check_light_spec import LightModel
+
+
+def test_no_forgery_accepted_small():
+    model = LightModel(n=4, heights=4, min_valset=3)
+    n_cfg, err = model.run()
+    assert err is None, err
+    assert n_cfg >= 64
+
+
+def test_no_forgery_accepted_two_member_valsets():
+    # min_valset=2 admits valsets where a single faulty validator is
+    # impossible under the assumption (1/3 of 2 rounds to 0) — the
+    # rule must still hold across mixed chains
+    model = LightModel(n=4, heights=3, min_valset=2)
+    n_cfg, err = model.run()
+    assert err is None, err
+
+
+def test_self_test_finds_forgery_without_assumption():
+    model = LightModel(n=4, heights=3, min_valset=3,
+                       break_assumption=True)
+    _n, err = model.run()
+    assert err is not None and "FORGERY" in err
+
+
+def test_thresholds_match_implementation():
+    """The model's two predicates must stay numerically identical to
+    validation.py's floor-divided strict thresholds — computed HERE
+    through the same Fraction arithmetic validation.py uses
+    (needed = total * num // den, accepted iff tallied > needed), so a
+    rounding-direction change there breaks this pin."""
+    from cometbft_tpu.types.validation import (
+        DEFAULT_TRUST_LEVEL, Fraction)
+    m = LightModel()
+    two_thirds = Fraction(2, 3)
+    for total in range(1, 30):
+        trusted = frozenset(range(total))
+        needed = (total * DEFAULT_TRUST_LEVEL.numerator
+                  // DEFAULT_TRUST_LEVEL.denominator)  # validation.py:192
+        for k in range(total + 1):
+            signers = frozenset(range(k))
+            assert m.trusting_ok(signers, trusted) == (k > needed)
+        needed23 = (total * two_thirds.numerator
+                    // two_thirds.denominator)
+        for k in range(total + 1):
+            signers = frozenset(range(k))
+            assert m.own_commit_ok(signers, trusted) == (k > needed23)
