@@ -1,0 +1,41 @@
+"""Declarative metric definitions — input to tools/metricsgen.py
+(reference scripts/metricsgen/metricsgen.go, which generates
+metrics.gen.go constructors from struct tags; here the "struct tags"
+are this spec and the generated constructors land in
+libs/metrics_gen.py).
+
+Regenerate after editing:  python tools/metricsgen.py
+A freshness test (tests/test_metricsgen.py) fails if the generated
+file drifts from this spec.
+"""
+
+# struct name -> list of (kind, field, metric_name, help, label_names)
+# kind in {"counter", "gauge", "histogram"}
+METRICS_SPEC = {
+    # reference p2p/metrics.go
+    "P2PMetrics": [
+        ("gauge", "peers", "p2p_peers",
+         "Number of connected peers", ()),
+        ("counter", "message_send_bytes_total",
+         "p2p_message_send_bytes_total",
+         "Bytes sent to peers, by channel", ("ch_id",)),
+        ("counter", "message_receive_bytes_total",
+         "p2p_message_receive_bytes_total",
+         "Bytes received from peers, by channel", ("ch_id",)),
+        ("counter", "peer_dial_failures", "p2p_peer_dial_failures",
+         "Failed outbound dial attempts", ()),
+    ],
+    # reference mempool/metrics.go
+    "MempoolMetrics": [
+        ("gauge", "size", "mempool_size",
+         "Transactions in the mempool", ()),
+        ("gauge", "size_bytes", "mempool_size_bytes",
+         "Total byte size of mempool transactions", ()),
+        ("counter", "failed_txs", "mempool_failed_txs",
+         "Transactions rejected by CheckTx", ()),
+        ("counter", "evicted_txs", "mempool_evicted_txs",
+         "Txs removed as invalid on post-commit recheck", ()),
+        ("counter", "recheck_times", "mempool_recheck_times",
+         "Post-commit recheck passes over the pool", ()),
+    ],
+}

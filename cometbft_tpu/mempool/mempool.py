@@ -112,6 +112,10 @@ class CListMempool:
         self._height = 0
         self._update_lock = threading.RLock()
         self._notify: List[Callable[[], None]] = []
+        # optional generated metrics struct
+        # (libs/metrics_gen.MempoolMetrics — reference
+        # mempool/metrics.go); None until the node wires it
+        self.metrics = None
 
     # --- admission -----------------------------------------------------------
 
@@ -133,12 +137,20 @@ class CListMempool:
             if code != CODE_TYPE_OK:
                 if not self._keep_invalid:
                     self.cache.remove(key)
+                if self.metrics is not None:
+                    self.metrics.failed_txs.inc()
                 return code
             self._txs[key] = _MempoolTx(tx, self._height, gas)
             self._bytes += len(tx)
+            self._set_gauges()
             for cb in self._notify:
                 cb(tx)
             return CODE_TYPE_OK
+
+    def _set_gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.size.set(len(self._txs))
+            self.metrics.size_bytes.set(self._bytes)
 
     def on_new_tx(self, cb: Callable[[bytes], None]) -> None:
         """Subscribe to tx arrival with the admitted tx (gossip relay /
@@ -203,10 +215,13 @@ class CListMempool:
                 self._bytes -= len(mt.tx)
         if self._recheck and self._txs:
             self._recheck_txs()
+        self._set_gauges()
 
     def _recheck_txs(self) -> None:
         """Re-run CheckTx on every pending tx (reference
         clist_mempool.go:655-687 recheckTxs)."""
+        if self.metrics is not None:
+            self.metrics.recheck_times.inc()
         for key in list(self._txs.keys()):
             mt = self._txs[key]
             code, gas = self._check_fn(mt.tx)
@@ -215,6 +230,8 @@ class CListMempool:
                 self._bytes -= len(mt.tx)
                 if not self._keep_invalid:
                     self.cache.remove(key)
+                if self.metrics is not None:
+                    self.metrics.evicted_txs.inc()
             else:
                 mt.gas_wanted = gas
 
@@ -223,6 +240,7 @@ class CListMempool:
             self._txs.clear()
             self._bytes = 0
             self.cache.reset()
+            self._set_gauges()
 
     # --- introspection -------------------------------------------------------
 

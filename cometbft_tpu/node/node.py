@@ -193,6 +193,12 @@ class Node:
             "crypto_pallas_canary_trips",
             "Silent-accept miscompiles caught (pallas then disabled)",
             fn=lambda: canary_stats()["trips"])
+        # generated metrics structs (tools/metricsgen.py from
+        # libs/metrics_defs.py — the reference's scripts/metricsgen
+        # role): mempool occupancy now, p2p wiring after the switch
+        # exists below
+        from ..libs.metrics_gen import MempoolMetrics
+        self.mempool.metrics = MempoolMetrics(self.metrics_registry)
         cc = config.consensus
         self.consensus = ConsensusState(
             ConsensusConfig(
@@ -221,6 +227,8 @@ class Node:
                              config.base.moniker,
                              send_rate=config.p2p.send_rate,
                              recv_rate=config.p2p.recv_rate)
+        from ..libs.metrics_gen import P2PMetrics
+        self.switch.metrics = P2PMetrics(self.metrics_registry)
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.consensus_reactor.attach(self.switch)
         self.blocksync_reactor = BlocksyncNetReactor(self.block_store)

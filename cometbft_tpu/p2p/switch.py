@@ -51,10 +51,21 @@ class Peer:
         self._mconn.stop()
 
     def send(self, channel_id: int, msg: bytes) -> bool:
-        return self._mconn.send(channel_id, msg, block=True)
+        ok = self._mconn.send(channel_id, msg, block=True)
+        if ok:  # dropped sends must not count as traffic
+            self._count_send(channel_id, len(msg))
+        return ok
 
     def try_send(self, channel_id: int, msg: bytes) -> bool:
-        return self._mconn.send(channel_id, msg, block=False)
+        ok = self._mconn.send(channel_id, msg, block=False)
+        if ok:
+            self._count_send(channel_id, len(msg))
+        return ok
+
+    def _count_send(self, channel_id: int, n: int) -> None:
+        m = self.switch.metrics
+        if m is not None:
+            m.message_send_bytes_total.inc(n, ch_id=f"{channel_id:#x}")
 
     def __repr__(self) -> str:
         return f"Peer{{{self.id[:12]} {'out' if self.outbound else 'in'}}}"
@@ -89,6 +100,9 @@ class Switch:
         self._persistent: Dict[Tuple[str, int], str] = {}
         self._ensure_stop = threading.Event()
         self._ensure_thread: Optional[threading.Thread] = None
+        # optional generated metrics struct (libs/metrics_gen.P2PMetrics
+        # — reference p2p/metrics.go); None until the node wires it
+        self.metrics = None
 
     # --- setup ----------------------------------------------------------------
 
@@ -157,7 +171,9 @@ class Switch:
                 try:
                     self.dial(*addr)
                 except OSError:
-                    pass  # peer down; retried next round
+                    if self.metrics is not None:
+                        self.metrics.peer_dial_failures.inc()
+                    # peer down; retried next round
             # jitter desynchronizes simultaneous re-dials between two
             # nodes that each just closed the other's duplicate
             self._ensure_stop.wait(1.0 + random.random())
@@ -178,6 +194,9 @@ class Switch:
                 return
             peer = Peer(self, sc, info, outbound)
             self._peers[info.node_id] = peer
+            if self.metrics is not None:  # inside the lock: a racing
+                self.metrics.peers.set(len(self._peers))  # stop_peer
+                # must not be overwritten with a stale count
         peer.start()
         for r in self._reactors:
             r.add_peer(peer)
@@ -194,6 +213,8 @@ class Switch:
             del self._peers[peer.id]
             if ban and peer.id not in self._persistent.values():
                 self.banned.add(peer.id)
+            if self.metrics is not None:
+                self.metrics.peers.set(len(self._peers))
         peer.stop()
         for r in self._reactors:
             r.remove_peer(peer, reason)
@@ -210,6 +231,9 @@ class Switch:
     # --- dispatch -------------------------------------------------------------
 
     def _dispatch(self, peer: Peer, channel_id: int, msg: bytes) -> None:
+        if self.metrics is not None:
+            self.metrics.message_receive_bytes_total.inc(
+                len(msg), ch_id=f"{channel_id:#x}")
         reactor = self._chan_to_reactor.get(channel_id)
         if reactor is None:
             self.stop_peer(peer, f"unclaimed channel {channel_id:#x}")

@@ -1,0 +1,45 @@
+"""metricsgen (tools/metricsgen.py ↔ libs/metrics_defs.py ↔ generated
+libs/metrics_gen.py; reference scripts/metricsgen/metricsgen.go +
+the CI check that metrics.gen.go is current)."""
+
+from cometbft_tpu.libs.metrics import Registry
+from cometbft_tpu.libs.metrics_gen import MempoolMetrics, P2PMetrics
+
+
+def test_generated_file_is_current():
+    """The committed metrics_gen.py must match the spec — the same
+    freshness gate the reference runs over metrics.gen.go."""
+    from tools.metricsgen import main
+    assert main(["--check"]) == 0
+
+
+def test_generated_structs_register_and_expose():
+    reg = Registry()
+    p2p = P2PMetrics(reg)
+    mp = MempoolMetrics(reg)
+    p2p.peers.set(3)
+    p2p.message_send_bytes_total.inc(128, ch_id="0x20")
+    mp.size.set(7)
+    mp.failed_txs.inc()
+    text = reg.expose()
+    assert "cometbft_tpu_p2p_peers 3" in text
+    assert 'ch_id="0x20"' in text
+    assert "cometbft_tpu_mempool_size 7" in text
+    assert "cometbft_tpu_mempool_failed_txs 1" in text
+
+
+def test_mempool_wiring_moves_gauges():
+    from cometbft_tpu.mempool.mempool import CListMempool
+    reg = Registry()
+    mp = CListMempool(check_fn=lambda tx: (0 if tx != b"bad" else 1, 0))
+    mp.metrics = MempoolMetrics(reg)
+    mp.check_tx(b"tx-1")
+    mp.check_tx(b"tx-22")
+    assert mp.metrics.size.value() == 2
+    assert mp.metrics.size_bytes.value() == len(b"tx-1") + len(b"tx-22")
+    mp.check_tx(b"bad")
+    assert mp.metrics.failed_txs.value() == 1
+    # committing tx-1 shrinks the gauges and bumps recheck
+    mp.update(1, [b"tx-1"])
+    assert mp.metrics.size.value() == 1
+    assert mp.metrics.recheck_times.value() == 1
