@@ -4,7 +4,12 @@ per-package metrics.go structs, e.g. internal/consensus/metrics.go:34).
 Counters, gauges, and histograms with label support, rendered in the
 Prometheus text exposition format. `Registry.expose()` plugs into any
 HTTP handler (config [instrumentation], reference config.go:1378-1384).
-No codegen: Python constructs the struct-of-metrics directly.
+Metrics structs come in two flavors: hand-written (ConsensusMetrics
+below — predates the codegen and is kept in place to avoid churning
+consensus wiring) and GENERATED from libs/metrics_defs.py by
+tools/metricsgen.py into libs/metrics_gen.py (the reference's
+scripts/metricsgen pattern). New structs should use the spec +
+generator.
 """
 
 from __future__ import annotations

@@ -11,6 +11,10 @@ file drifts from this spec.
 
 # struct name -> list of (kind, field, metric_name, help, label_names)
 # kind in {"counter", "gauge", "histogram"}
+#
+# ConsensusMetrics stays hand-written in libs/metrics.py: it predates
+# this generator and migrating it would churn consensus wiring for no
+# behavior change; every NEW struct belongs here.
 METRICS_SPEC = {
     # reference p2p/metrics.go
     "P2PMetrics": [

@@ -197,7 +197,8 @@ class Node:
         # libs/metrics_defs.py — the reference's scripts/metricsgen
         # role): mempool occupancy now, p2p wiring after the switch
         # exists below
-        from ..libs.metrics_gen import MempoolMetrics
+        from ..libs.metrics_gen import MempoolMetrics, P2PMetrics
+        self._p2p_metrics_cls = P2PMetrics
         self.mempool.metrics = MempoolMetrics(self.metrics_registry)
         cc = config.consensus
         self.consensus = ConsensusState(
@@ -227,8 +228,8 @@ class Node:
                              config.base.moniker,
                              send_rate=config.p2p.send_rate,
                              recv_rate=config.p2p.recv_rate)
-        from ..libs.metrics_gen import P2PMetrics
-        self.switch.metrics = P2PMetrics(self.metrics_registry)
+        self.switch.metrics = self._p2p_metrics_cls(
+            self.metrics_registry)
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.consensus_reactor.attach(self.switch)
         self.blocksync_reactor = BlocksyncNetReactor(self.block_store)

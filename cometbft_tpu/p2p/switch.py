@@ -138,7 +138,14 @@ class Switch:
                 self._persistent[addr] = info.node_id
             self._on_connection(sc, info, outbound)
 
-        self.transport.dial(host, port, on_conn)
+        try:
+            self.transport.dial(host, port, on_conn)
+        except OSError:
+            # count here so EVERY dial path (persistent re-dial, PEX,
+            # RPC dial_peers) feeds the metric
+            if self.metrics is not None:
+                self.metrics.peer_dial_failures.inc()
+            raise
 
     def add_persistent_peer(self, host: str, port: int) -> None:
         """Register for dial-now + re-dial-forever (reference
@@ -171,9 +178,7 @@ class Switch:
                 try:
                     self.dial(*addr)
                 except OSError:
-                    if self.metrics is not None:
-                        self.metrics.peer_dial_failures.inc()
-                    # peer down; retried next round
+                    pass  # counted in dial(); retried next round
             # jitter desynchronizes simultaneous re-dials between two
             # nodes that each just closed the other's duplicate
             self._ensure_stop.wait(1.0 + random.random())
