@@ -1,0 +1,24 @@
+#!/bin/bash
+# Full test suite in TWO pytest processes instead of one.
+#
+# Why: this jaxlib's XLA:CPU backend can SEGFAULT (stack-guard hit in
+# libjax_common) in a process that has accumulated many kernel
+# compilations — the same failure mode that already forces the
+# mesh/pallas tests into fresh interpreters (tests/_mesh_harness.py,
+# docs/PERF.md "known compile hazard"). A single `pytest tests/` run
+# stacks every in-process compile from ~40 modules into one process
+# and can cross the cliff mid-suite; splitting at the alphabetical
+# midpoint keeps each process's compile count near round-4 levels.
+#
+# Usage: bash tools/run_suite.sh [extra pytest args]
+set -u
+cd "$(dirname "$0")/.."
+ARGS=("$@")
+FIRST=(tests/test_[a-o]*.py)
+SECOND=(tests/test_[p-z]*.py)
+rc=0
+echo "=== suite 1/2: ${#FIRST[@]} modules (a-o) ===" >&2
+python -m pytest "${FIRST[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
+echo "=== suite 2/2: ${#SECOND[@]} modules (p-z) ===" >&2
+python -m pytest "${SECOND[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
+exit $rc
