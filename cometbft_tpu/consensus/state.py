@@ -104,6 +104,27 @@ class _BroadcastMarker:
 Message = Union[ProposalMessage, BlockPartMessage, VoteMessage, TimeoutInfo]
 
 
+# Thread-confinement checking (the Python analog of the reference's
+# `go test -race` CI runs, SURVEY §5.2): the consensus design's core
+# concurrency invariant is that ONLY the receive routine mutates round
+# state — every other thread communicates through the inbox. With
+# COMETBFT_TPU_THREAD_CHECK=1, RoundState verifies every attribute
+# write against its claimed owner thread and raises on a violation, so
+# a stray cross-thread mutation fails tests loudly instead of racing
+# silently. Off by default the per-write cost is one module-global
+# load and a false branch inside __setattr__ (the hook itself stays
+# installed so tests can arm the check at runtime).
+import os as _os
+
+_THREAD_CHECK = _os.environ.get("COMETBFT_TPU_THREAD_CHECK") == "1"
+# violations observed (tests assert 0 after a checked run: a violation
+# raised inside the receive routine's generic exception guard would
+# otherwise be logged-and-survived); lock-guarded — concurrent
+# violators must not undercount
+_thread_check_violations = 0
+_violation_lock = threading.Lock()
+
+
 @dataclass
 class RoundState:
     """reference internal/consensus/types/round_state.go:65-100."""
@@ -128,6 +149,28 @@ class RoundState:
     last_commit: Optional[VoteSet] = None
     triggered_timeout_precommit: bool = False
 
+    def claim(self, tid: int) -> None:
+        """Record thread `tid` as this round state's owner. The claim
+        is always recorded; ENFORCEMENT happens in __setattr__ only
+        while _THREAD_CHECK is on (so tests can arm the check at
+        runtime against claims made earlier)."""
+        object.__setattr__(self, "_owner_tid", tid)
+
+    def __setattr__(self, name, value):
+        if _THREAD_CHECK:
+            owner = getattr(self, "_owner_tid", None)
+            if owner is not None and \
+                    threading.get_ident() != owner:
+                global _thread_check_violations
+                with _violation_lock:
+                    _thread_check_violations += 1
+                raise RuntimeError(
+                    f"single-writer violation: RoundState.{name} "
+                    f"mutated from thread {threading.get_ident()} "
+                    f"(writer is {owner}) — round state may only be "
+                    f"touched by the consensus receive routine")
+        object.__setattr__(self, name, value)
+
 
 class ConsensusState:
     """reference internal/consensus/state.go State."""
@@ -146,6 +189,7 @@ class ConsensusState:
         self.chain_id = state.chain_id
 
         self.rs = RoundState()
+        self._writer_tid: Optional[int] = None
         self.state = state  # committed state (height = last applied)
 
         self.inbox: "queue.Queue" = queue.Queue()
@@ -205,6 +249,10 @@ class ConsensusState:
 
     def receive_routine(self) -> None:
         """Single writer (reference state.go:778-866)."""
+        # declare this thread the round-state owner (thread-confinement
+        # checking, see RoundState.claim — the race-detector analog)
+        self._writer_tid = threading.get_ident()
+        self.rs.claim(self._writer_tid)
         while not self._stop.is_set():
             msg = self.inbox.get()
             if msg is None:
@@ -378,6 +426,8 @@ class ConsensusState:
                 .extensions_enabled(height)),
             last_commit=last_precommits,
         )
+        if self._writer_tid is not None:
+            self.rs.claim(self._writer_tid)
         if self.metrics is not None:
             self.metrics.height.set(state.last_block_height)
             self.metrics.validators.set(len(state.validators.validators))
