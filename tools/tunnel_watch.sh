@@ -12,7 +12,12 @@
 set -u
 cd /root/repo
 LOG=/root/repo/tunnel_watch.log
-echo "$(date -u +%F' '%H:%M:%S) watcher start" >> "$LOG"
+# after this wall-clock deadline, capture ONLY the bench (the A/B and
+# sweep would hold the single-client tunnel for hours and could block
+# the round driver's own bench run at round end)
+EXTRAS_DEADLINE=${WATCH_EXTRAS_DEADLINE:-$(( $(date +%s) + 4 * 3600 ))}
+echo "$(date -u +%F' '%H:%M:%S) watcher start (extras until "\
+"$(date -u -d @$EXTRAS_DEADLINE +%H:%M))" >> "$LOG"
 for i in $(seq 1 200); do
   out=$(timeout 75 python -c "
 import sys; sys.path.insert(0, '/root/repo')
@@ -27,9 +32,18 @@ print('ALIVE', jax.devices()[0].platform, flush=True)
       > /root/repo/BENCH_r05_live.json 2>> "$LOG"
     rc=$?
     echo "$(date -u +%F' '%H:%M:%S) bench rc=$rc: $(cat /root/repo/BENCH_r05_live.json)" >> "$LOG"
+    if [ "$(date +%s)" -gt "$EXTRAS_DEADLINE" ]; then
+      echo "$(date -u +%F' '%H:%M:%S) past extras deadline — leaving "\
+"the tunnel free for the driver" >> "$LOG"
+      exit 0
+    fi
     AB_N=8192 timeout 2700 python tools/ab_pallas.py \
       > /root/repo/docs/ab_r05.log 2>&1
     echo "$(date -u +%F' '%H:%M:%S) ab_pallas rc=$?" >> "$LOG"
+    if [ "$(date +%s)" -gt "$EXTRAS_DEADLINE" ]; then
+      echo "$(date -u +%F' '%H:%M:%S) past extras deadline — skipping sweep" >> "$LOG"
+      exit 0
+    fi
     AB_N=8192 AB_SWEEP=256,1024,2048 timeout 7500 python tools/ab_pallas.py \
       > /root/repo/docs/ab_r05_sweep.log 2>&1
     echo "$(date -u +%F' '%H:%M:%S) tile sweep rc=$? — watcher done" >> "$LOG"
