@@ -32,16 +32,19 @@ print('ALIVE', jax.devices()[0].platform, flush=True)
       > /root/repo/BENCH_r05_live.json 2>> "$LOG"
     rc=$?
     echo "$(date -u +%F' '%H:%M:%S) bench rc=$rc: $(cat /root/repo/BENCH_r05_live.json)" >> "$LOG"
-    if [ "$(date +%s)" -gt "$EXTRAS_DEADLINE" ]; then
-      echo "$(date -u +%F' '%H:%M:%S) past extras deadline — leaving "\
-"the tunnel free for the driver" >> "$LOG"
+    # gate on START + WORST-CASE duration: a stage must FINISH before
+    # the deadline, not merely start before it
+    if [ "$(( $(date +%s) + 2700 ))" -gt "$EXTRAS_DEADLINE" ]; then
+      echo "$(date -u +%F' '%H:%M:%S) A/B cannot finish before the "\
+"extras deadline — leaving the tunnel free for the driver" >> "$LOG"
       exit 0
     fi
     AB_N=8192 timeout 2700 python tools/ab_pallas.py \
       > /root/repo/docs/ab_r05.log 2>&1
     echo "$(date -u +%F' '%H:%M:%S) ab_pallas rc=$?" >> "$LOG"
-    if [ "$(date +%s)" -gt "$EXTRAS_DEADLINE" ]; then
-      echo "$(date -u +%F' '%H:%M:%S) past extras deadline — skipping sweep" >> "$LOG"
+    if [ "$(( $(date +%s) + 7500 ))" -gt "$EXTRAS_DEADLINE" ]; then
+      echo "$(date -u +%F' '%H:%M:%S) sweep cannot finish before the "\
+"extras deadline — skipping" >> "$LOG"
       exit 0
     fi
     AB_N=8192 AB_SWEEP=256,1024,2048 timeout 7500 python tools/ab_pallas.py \
