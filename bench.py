@@ -101,6 +101,26 @@ def probe_backend():
     return None
 
 
+def resolve_backend_or_pin_cpu() -> str:
+    """Shared bench-tool discipline (bench_blocksync, bench_light):
+    probe the backend in a throwaway subprocess; if the device is
+    unavailable (wedged tunnel / cpu-only), pin the cpu platform so no
+    code path blocks on the tunnel, AND drop the persistent compile
+    cache that enable_compile_cache admitted under the device
+    assumption (XLA:CPU AOT reloads risk SIGILL on machine-feature
+    mismatch). Returns "device" or "cpu"."""
+    from cometbft_tpu.libs.jax_cache import (disable_persistent_cache,
+                                             is_device_platform)
+    platform = probe_backend()
+    if platform not in (None, "cpu"):
+        return "device"
+    if is_device_platform():
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    disable_persistent_cache()
+    return "cpu"
+
+
 def _gen_signatures(n, n_validators=200, msg_len=122, seed=7):
     """n signatures from a 200-key validator set over vote-sized messages.
 

@@ -115,6 +115,30 @@ def generate_chain(n_blocks: int, n_validators: int = 4,
                           keys=keys, valsets=valsets)
 
 
+class ChainLightProvider:
+    """Light-client provider over a GeneratedChain (the mock-provider
+    analog, reference light/provider/mock) — shared by the light tests
+    and tools/bench_light.py."""
+
+    def __init__(self, chain: GeneratedChain):
+        self.chain = chain
+
+    def chain_id(self) -> str:
+        return self.chain.chain_id
+
+    def light_block(self, height: int):
+        from ..light.provider import ErrLightBlockNotFound
+        from ..light.types import LightBlock, SignedHeader
+        if height == 0:
+            height = self.chain.max_height()
+        if not (1 <= height <= self.chain.max_height()):
+            raise ErrLightBlockNotFound(str(height))
+        blk = self.chain.blocks[height - 1]
+        return LightBlock(
+            SignedHeader(blk.header, self.chain.seen_commits[height - 1]),
+            self.chain.valsets[height - 1].copy())
+
+
 class LocalChainSource:
     """PeerSource over a generated chain — the in-memory peer
     (reference test doubles in internal/blocksync/pool_test.go)."""

@@ -18,31 +18,28 @@ CHAIN_LEN = 24
 TRUST_PERIOD = 10**9
 
 
-class ChainProvider:
-    """Provider over a GeneratedChain (the mock-provider analog)."""
+from cometbft_tpu.engine.chain_gen import ChainLightProvider
+
+
+class ChainProvider(ChainLightProvider):
+    """ChainLightProvider plus optional header tampering (witness
+    divergence tests)."""
 
     def __init__(self, chain, tamper_height=None):
-        self.chain = chain
+        super().__init__(chain)
         self.tamper_height = tamper_height
-
-    def chain_id(self):
-        return self.chain.chain_id
 
     def light_block(self, height: int) -> LightBlock:
         if height == 0:
             height = self.chain.max_height()
-        if not (1 <= height <= self.chain.max_height()):
-            raise ErrLightBlockNotFound(str(height))
-        blk = self.chain.blocks[height - 1]
-        commit = self.chain.seen_commits[height - 1]
-        vals = self.chain.valsets[height - 1]
-        lb = LightBlock(SignedHeader(blk.header, commit), vals.copy())
+        lb = super().light_block(height)
         if height == self.tamper_height:
             # a forged header (wrong app hash) with the ORIGINAL commit —
             # witness comparison must flag the mismatch
             from dataclasses import replace
-            hdr = replace(blk.header, app_hash=b"\x66" * 32)
-            lb = LightBlock(SignedHeader(hdr, commit), vals.copy())
+            hdr = replace(lb.signed_header.header, app_hash=b"\x66" * 32)
+            lb = LightBlock(SignedHeader(hdr, lb.signed_header.commit),
+                            lb.validator_set)
         return lb
 
 

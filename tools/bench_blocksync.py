@@ -52,19 +52,16 @@ def main(argv=None):
     if args.batch == "auto":
         # the device path blocks FOREVER on a wedged TPU tunnel, so the
         # choice is made by PROBING the backend in a throwaway
-        # subprocess (bench.py's discipline), not by the configured
-        # platform string
-        from bench import probe_backend
-        platform = probe_backend()
-        batch = 0 if platform in (None, "cpu") else 8192
+        # subprocess, pinning the cpu platform (and dropping the
+        # device-assumption compile cache) when unavailable — the
+        # shared bench-tool discipline (bench.resolve_backend_or_pin_cpu)
+        from bench import resolve_backend_or_pin_cpu
+        batch = 8192 if resolve_backend_or_pin_cpu() == "device" else 0
     else:
         batch = int(args.batch)
-    if batch == 0 and is_device_platform():
-        # native verify on a device-configured host: pin the cpu
-        # platform so no code path (chain-gen's executor included)
-        # touches the possibly-wedged tunnel
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        if batch == 0 and is_device_platform():
+            from bench import resolve_backend_or_pin_cpu
+            resolve_backend_or_pin_cpu()
 
     t0 = time.monotonic()
     print(f"[bench_blocksync] generating {args.blocks} blocks x "

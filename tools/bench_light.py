@@ -1,0 +1,105 @@
+"""Light-client verification benchmark (the BASELINE.json "light
+client: sequential verify of SignedHeaders, 150 validators" config;
+reference light/client_benchmark_test.go:24-75 — harness-only there
+too, sequential vs bisection over a mock chain).
+
+Generates an N-block chain with a V-validator set, then times a light
+client catching up to the tip BOTH ways:
+  sequential — verify every header 2..N (adjacent rule each step);
+  bisection  — skipping verification with the 1/3-trust rule (static
+               valset: one jump).
+Reports headers/s for the sequential pass and total wall for each.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/bench_light.py [--blocks 64]
+        [--validators 150] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", type=int, default=64)
+    ap.add_argument("--validators", type=int, default=150)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    # device-vs-cpu by PROBING (the shared bench-tool discipline —
+    # the ambient config pins the TPU platform even under
+    # JAX_PLATFORMS=cpu, and any verify_batch jit then blocks forever
+    # on a wedged tunnel)
+    from bench import resolve_backend_or_pin_cpu
+    from cometbft_tpu.libs.jax_cache import enable_compile_cache
+    enable_compile_cache()
+    backend = resolve_backend_or_pin_cpu()
+
+    from cometbft_tpu.db.kv import MemDB
+    from cometbft_tpu.engine.chain_gen import (ChainLightProvider,
+                                               generate_chain)
+    from cometbft_tpu.light.client import LightClient, TrustOptions
+    from cometbft_tpu.light.store import LightStore
+    from cometbft_tpu.types.proto import Timestamp
+
+    t0 = time.monotonic()
+    print(f"[bench_light] generating {args.blocks} blocks x "
+          f"{args.validators} validators...", file=sys.stderr, flush=True)
+    chain = generate_chain(n_blocks=args.blocks,
+                           n_validators=args.validators)
+    print(f"[bench_light] chain in {time.monotonic() - t0:.1f}s",
+          file=sys.stderr, flush=True)
+
+    now = Timestamp(1_700_000_000 + chain.max_height() + 5, 0)
+    opts = TrustOptions(period_seconds=30 * 24 * 3600, height=1,
+                        hash=chain.blocks[0].hash())
+
+    def catchup(sequential: bool) -> float:
+        client = LightClient(chain.chain_id, opts,
+                             ChainLightProvider(chain), [],
+                             LightStore(MemDB()), sequential=sequential,
+                             now_fn=lambda: now)
+        t = time.monotonic()
+        lb = client.verify_light_block_at_height(chain.max_height())
+        dt = time.monotonic() - t
+        assert lb.height == chain.max_height()
+        return dt
+
+    seq_s = catchup(sequential=True)
+    # first bisection may pay a one-time jit of the 64-lane RLC bucket
+    # (minutes on XLA:CPU, docs/PERF.md); the steady-state number is
+    # the warm second pass
+    cold_bis_s = catchup(sequential=False)
+    bis_s = catchup(sequential=False)
+    headers = args.blocks - 1  # sequential verifies 2..N
+
+    rec = {
+        "metric": "light_client_verify",
+        "sequential_headers_per_sec": round(headers / seq_s, 1),
+        "sequential_seconds": round(seq_s, 3),
+        "bisection_seconds": round(bis_s, 3),
+        "bisection_cold_seconds": round(cold_bis_s, 3),
+        "unit": "headers/s",
+        "blocks": args.blocks,
+        "validators": args.validators,
+        "sigs_per_commit": args.validators,
+        "backend": backend,
+    }
+    if args.json:
+        print(json.dumps(rec))
+    else:
+        print(f"light client: sequential {rec['sequential_headers_per_sec']}"
+              f" headers/s ({seq_s:.2f}s for {headers} headers x "
+              f"{args.validators} sigs), bisection to tip {bis_s:.3f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
