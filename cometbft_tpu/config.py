@@ -1,8 +1,9 @@
 """Node configuration (reference config/config.go:78-93 — the master
 Config of sections — and config/toml.go's file round-trip).
 
-TOML read uses the stdlib tomllib; writing emits the subset grammar we
-read back (flat sections of scalars).
+TOML read uses the stdlib tomllib where it exists (Python >= 3.11);
+on older interpreters `loads_flat_toml` falls back to parsing the
+exact subset grammar `to_toml` emits (flat sections of scalars).
 """
 
 from __future__ import annotations
@@ -10,6 +11,44 @@ from __future__ import annotations
 import os
 from dataclasses import asdict, dataclass, field as dc_field
 from typing import Optional
+
+
+def loads_flat_toml(text: str) -> dict:
+    """tomllib.loads when available; otherwise parse the flat subset
+    `Config.to_toml` emits — `[section]` headers over `key = scalar`
+    lines where scalar is true/false, an int, a float, or a
+    JSON-escaped basic string. Python 3.10 images have no tomllib and
+    no third-party toml wheel, and node boot must not depend on one."""
+    try:
+        import tomllib
+        return tomllib.loads(text)
+    except ModuleNotFoundError:
+        pass
+    import json
+    out: dict = {}
+    section = out
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = out.setdefault(line[1:-1].strip(), {})
+            continue
+        key, sep, val = line.partition("=")
+        if not sep:
+            raise ValueError(f"toml line {ln}: expected key = value, "
+                             f"got {raw!r}")
+        key, val = key.strip(), val.strip()
+        if val.startswith('"'):
+            section[key] = json.loads(val)
+        elif val in ("true", "false"):
+            section[key] = val == "true"
+        else:
+            try:
+                section[key] = int(val)
+            except ValueError:
+                section[key] = float(val)
+    return out
 
 
 @dataclass
@@ -276,8 +315,7 @@ class Config:
 
     @classmethod
     def from_toml(cls, text: str, root_dir: str = ".") -> "Config":
-        import tomllib
-        d = tomllib.loads(text)
+        d = loads_flat_toml(text)
         cfg = cls(root_dir=root_dir)
         for section, target in (("base", cfg.base), ("p2p", cfg.p2p),
                                 ("rpc", cfg.rpc),

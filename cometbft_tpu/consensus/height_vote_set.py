@@ -49,18 +49,24 @@ class HeightVoteSet:
     def precommits(self, round_: int) -> VoteSet:
         return self._get(round_, PRECOMMIT_TYPE)
 
+    def _check_catchup_round(self, round_: int, peer_id: str) -> None:
+        """Peers may touch at most 2 rounds beyond round+1 (reference
+        height_vote_set.go:126-151) — the DoS bound on per-round VoteSet
+        allocation, shared by vote intake and maj23 claims."""
+        if round_ > self.round + 1 and peer_id:
+            rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+            if round_ not in rounds:
+                if len(rounds) >= 2:
+                    raise ValueError(
+                        "peer has sent votes for too many catchup rounds")
+                rounds.append(round_)
+
     def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
         """reference height_vote_set.go:126-151: peers may push votes for
         up to 2 catchup rounds beyond the current round."""
         if vote.type_ not in (PREVOTE_TYPE, PRECOMMIT_TYPE):
             raise ValueError(f"bad vote type {vote.type_}")
-        if vote.round > self.round + 1 and peer_id:
-            rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
-            if vote.round not in rounds:
-                if len(rounds) >= 2:
-                    raise ValueError(
-                        "peer has sent votes for too many catchup rounds")
-                rounds.append(vote.round)
+        self._check_catchup_round(vote.round, peer_id)
         vs = self._get(vote.round, vote.type_)
         return vs.add_vote(vote)
 
@@ -77,4 +83,13 @@ class HeightVoteSet:
 
     def set_peer_maj23(self, round_: int, type_: int, peer_id: str,
                        block_id: BlockID) -> None:
+        """A claim may target ANY round the decided commit used (the
+        laggard's own round can lag the decision round arbitrarily), so
+        it is bounded exactly like vote intake: rounds past round+1
+        charge the peer's 2-catchup-round allowance rather than being
+        rejected outright — the claim and the commit votes it precedes
+        land on the same round and share one slot."""
+        if type_ not in (PREVOTE_TYPE, PRECOMMIT_TYPE):
+            raise ValueError(f"bad vote type {type_}")
+        self._check_catchup_round(round_, peer_id)
         self._get(round_, type_).set_peer_maj23(peer_id, block_id)

@@ -14,15 +14,15 @@ Wire: u8 kind || body. kinds: 1 proposal, 2 block part, 3 vote.
 from __future__ import annotations
 
 import threading
-import time
 from typing import List
 
+from ..libs import timesource
 from ..p2p.mconn import ChannelDescriptor
 from ..types import proto
-from ..types.block import Commit, Part
+from ..types.block import BlockID, Commit, Part
 from ..types.vote import Vote, PRECOMMIT_TYPE, PREVOTE_TYPE
 from .state import (BlockPartMessage, ConsensusState, Message,
-                    ProposalMessage, VoteMessage)
+                    ProposalMessage, VoteMessage, VoteSetMaj23Message)
 from .wal import _decode_proposal, _encode_proposal
 
 DATA_CHANNEL = 0x21
@@ -32,6 +32,7 @@ _PROPOSAL = 1
 _BLOCK_PART = 2
 _VOTE = 3
 _ROUND_STATE = 4
+_MAJ23 = 5
 
 
 class RoundStateMessage:
@@ -104,6 +105,12 @@ def encode_consensus_msg(msg: Message) -> tuple[int, bytes]:
         return DATA_CHANNEL, bytes([_BLOCK_PART]) + body
     if isinstance(msg, VoteMessage):
         return VOTE_CHANNEL, bytes([_VOTE]) + msg.vote.encode()
+    if isinstance(msg, VoteSetMaj23Message):
+        body = (proto.f_varint(1, msg.height)
+                + proto.f_varint(2, msg.round)
+                + proto.f_varint(3, msg.type_)
+                + proto.f_embed(4, msg.block_id.encode()))
+        return VOTE_CHANNEL, bytes([_MAJ23]) + body
     raise TypeError(f"cannot gossip {type(msg)}")
 
 
@@ -119,6 +126,14 @@ def decode_consensus_msg(raw: bytes) -> Message:
             Part.decode(proto.field_bytes(f, 3, b"")))
     if kind == _VOTE:
         return VoteMessage(Vote.decode(body))
+    if kind == _MAJ23:
+        f = proto.parse_fields(body)
+        bid = proto.field_bytes(f, 4, None)
+        return VoteSetMaj23Message(
+            proto.to_int64(proto.field_int(f, 1, 0)),
+            proto.to_int64(proto.field_int(f, 2, 0)),
+            proto.field_int(f, 3, 0),
+            BlockID.decode(bid) if bid is not None else BlockID())
     raise ValueError(f"unknown consensus wire kind {kind}")
 
 
@@ -288,7 +303,7 @@ class ConsensusReactor:
         # the honest reconcile cadence, or a hostile peer looping
         # ~30-byte summaries becomes a bandwidth amplifier (the same
         # attacker model as _serve_decided_height's token bucket)
-        now = time.monotonic()
+        now = timesource.monotonic()
         if now - self._reconcile_served.get(peer.id, 0.0) < \
                 self.RECONCILE_SECS * 0.8:
             return
@@ -377,7 +392,7 @@ class ConsensusReactor:
             return
         if not (store.base() <= h <= store.height()):
             return
-        now = time.monotonic()
+        now = timesource.monotonic()
         # the budget is a per-PEER token bucket, not per (peer, height):
         # the triggering vote is unauthenticated, and a per-height limit
         # would let one peer sweep base()..height()-2 with ~100-byte
@@ -398,6 +413,15 @@ class ConsensusReactor:
         commit = store.load_seen_commit(h) or store.load_block_commit(h)
         if commit is None:
             return
+        # announce the decided block's 2/3 majority FIRST: if the
+        # laggard recorded an equivocator's conflicting precommit, the
+        # commit's version is rejected as a conflict unless the vote set
+        # was told to track this block (set_peer_maj23) — without the
+        # claim the laggard can never reassemble the commit and wedges
+        # at h forever (simnet byzantine-proposer finding)
+        ch, raw = encode_consensus_msg(VoteSetMaj23Message(
+            h, commit.round, PRECOMMIT_TYPE, commit.block_id))
+        peer.try_send(ch, raw)
         if not cs.state.consensus_params.extensions_enabled(h):
             # reconstructed votes cannot carry extension signatures and
             # extension-checking vote sets reject votes without them, so

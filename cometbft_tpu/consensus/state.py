@@ -94,6 +94,22 @@ class VoteMessage:
 
 
 @dataclass(frozen=True)
+class VoteSetMaj23Message:
+    """A peer's claim that `block_id` has a 2/3 majority at
+    (height, round, type) — reference consensus/types VoteSetMaj23.
+    Unlocks VoteSet's conflicting-vote tracking (set_peer_maj23) so an
+    equivocator's commit-backed vote can still be admitted after its
+    conflicting twin arrived first; without the claim a laggard that
+    recorded the wrong twin can NEVER assemble the decided commit and
+    wedges at that height forever (found by simnet byzantine-proposer
+    seed sweeps)."""
+    height: int
+    round: int
+    type_: int
+    block_id: BlockID
+
+
+@dataclass(frozen=True)
 class _BroadcastMarker:
     """Internal-queue entry: gossip `msg` once the local deliveries
     queued ahead of it have been processed (see
@@ -101,7 +117,8 @@ class _BroadcastMarker:
     msg: "Message"
 
 
-Message = Union[ProposalMessage, BlockPartMessage, VoteMessage, TimeoutInfo]
+Message = Union[ProposalMessage, BlockPartMessage, VoteMessage,
+                VoteSetMaj23Message, TimeoutInfo]
 
 
 # Thread-confinement checking (the Python analog of the reference's
@@ -324,6 +341,11 @@ class ConsensusState:
         if isinstance(msg, TimeoutInfo):
             self._handle_timeout(msg)
             return
+        if isinstance(msg, VoteSetMaj23Message):
+            # a hint, not a vote: not WAL-logged (a lost claim is
+            # re-announced by whichever peer serves the catch-up again)
+            self._on_maj23(msg, peer_id)
+            return
         if isinstance(msg, ProposalMessage):
             if not self._replaying:
                 self.wal.write(WALProposal(msg.proposal, peer_id))
@@ -506,6 +528,10 @@ class ConsensusState:
             self.priv_validator.sign_proposal(self.chain_id, proposal)
         except DoubleSignError:
             return
+        from ..libs.fail import fail_point
+        fail_point("propose:signed")  # privval persisted, WAL not yet —
+        # the proposer-side crash window (simnet crash schedules target
+        # this label; replay must re-release the identical signature)
         # deliver to self through the internal queue path; gossip is
         # queued BEHIND the local delivery (WAL-then-wire ordering)
         self.handle_msg(ProposalMessage(proposal))
@@ -894,6 +920,27 @@ class ConsensusState:
         self.handle_msg(VoteMessage(vote))
         self._broadcast_after_processing(VoteMessage(vote))
 
+    def _on_maj23(self, msg: VoteSetMaj23Message, peer_id: str) -> None:
+        """reference state.go handleMsg VoteSetMaj23Message →
+        HeightVoteSet.SetPeerMaj23.
+
+        The message is unauthenticated and set_peer_maj23 allocates a
+        VoteSet per (round, type), so HeightVoteSet bounds claims
+        exactly like vote intake: real vote types only, and rounds past
+        round+1 charge the peer's 2-catchup-round allowance. A claim
+        for the decided commit's round must never be rejected outright
+        — the laggard's own round can lag the decision round
+        arbitrarily, and dropping the claim re-wedges the very case
+        this message exists to unwedge."""
+        rs = self.rs
+        if msg.height != rs.height or rs.votes is None or msg.round < 0:
+            return
+        try:
+            rs.votes.set_peer_maj23(msg.round, msg.type_,
+                                    peer_id or "catchup", msg.block_id)
+        except (VoteError, ValueError):
+            pass  # bad type / conflicting claim / catchup budget spent
+
     def _try_add_vote(self, vote: Vote, peer_id: str) -> None:
         """reference state.go:2256-2339 tryAddVote: conflicting votes
         become evidence instead of crashing the loop."""
@@ -956,7 +1003,22 @@ class ConsensusState:
                 if not ok:
                     raise VoteError("app rejected vote extension")
 
-        rs.votes.add_vote(vote, peer_id)
+        try:
+            rs.votes.add_vote(vote, peer_id)
+        except ErrVoteConflictingVotes as err:
+            if not err.added:
+                raise
+            # conflicting but ADDED (a peer claimed a 2/3 majority for
+            # this block, so the set tracked it — vote_set.go:301): the
+            # vote counts toward that block, so run the transition
+            # hooks exactly as the reference does (state.go addVote
+            # proceeds when added even with a conflict error), THEN
+            # surface the equivocation for the evidence pool
+            if vote.type_ == PREVOTE_TYPE:
+                self._on_prevote_added(vote)
+            else:
+                self._on_precommit_added(vote)
+            raise
         if vote.type_ == PREVOTE_TYPE:
             self._on_prevote_added(vote)
         else:

@@ -19,7 +19,12 @@ import hashlib
 import os
 from typing import Tuple
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        ChaCha20Poly1305)
+except ImportError:  # pragma: no cover — keep the module importable
+    # without the cryptography wheel; armoring then raises at use
+    ChaCha20Poly1305 = None
 
 _HEADER = "-----BEGIN COMETBFT_TPU PRIVATE KEY-----"
 _FOOTER = "-----END COMETBFT_TPU PRIVATE KEY-----"
@@ -35,8 +40,15 @@ def _derive(passphrase: str, salt: bytes) -> bytes:
                                _KDF_ROUNDS, dklen=32)
 
 
+def _require_aead():
+    if ChaCha20Poly1305 is None:
+        raise ArmorError("the 'cryptography' package is required for "
+                         "key armoring; it is not installed")
+
+
 def encrypt_armor_privkey(key_bytes: bytes, key_type: str,
                           passphrase: str) -> str:
+    _require_aead()
     salt = os.urandom(16)
     nonce = os.urandom(12)
     aead = ChaCha20Poly1305(_derive(passphrase, salt))
@@ -76,6 +88,7 @@ def unarmor_decrypt_privkey(armored: str, passphrase: str
     except (KeyError, ValueError) as e:
         raise ArmorError(f"malformed armor: {e}") from e
     key_type = headers.get("type", "")
+    _require_aead()
     aead = ChaCha20Poly1305(_derive(passphrase, salt))
     try:
         plain = aead.decrypt(blob[:12], blob[12:], key_type.encode())

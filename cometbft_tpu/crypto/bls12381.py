@@ -11,21 +11,32 @@ Scope and compatibility:
   compressed G2 signatures (ZCash serialization: compression/infinity/
   sign bits in the top three bits of the first byte), address =
   sha256(pubkey)[:20] (tmhash.SumTruncated), and messages longer than
-  32 bytes are sha256-hashed while shorter ones are zero-padded to 32
+  32 bytes are sha256-hashed before signing
   (key_bls12381.go:84-97,122-144 Sign/VerifySignature).
 - The PAIRING is the real thing: optimal-ate-style Miller loop over
   the Fq12 tower with a full final exponentiation — verification is
   e(g1, sig) == e(pk, H(m)) with subgroup checks on deserialization.
-- The HASH-TO-CURVE is a documented deviation: expand_message_xmd
-  (RFC 9380 §5.3.1, SHA-256) feeding a deterministic try-and-increment
-  map onto the twist, then cofactor clearing — NOT the IETF SSWU
-  suite. The SSWU 3-isogeny constant tables cannot be transcribed here
-  with confidence and no blst/py_ecc exists in the image to validate
-  them against; a sound, deterministic, constant-documented map keeps
-  the scheme secure (hash outputs are indistinguishable from random
-  curve points) at the cost of signature interop with Ethereum-suite
-  signers. Swapping `hash_to_g2` for SSWU restores byte interop
-  without touching anything else.
+- Two DOCUMENTED interop deviations (both local to the sign path;
+  verification of our own signatures is self-consistent):
+  1. HASH-TO-CURVE: expand_message_xmd (RFC 9380 §5.3.1, SHA-256)
+     feeding a deterministic try-and-increment map onto the twist,
+     then cofactor clearing — NOT the IETF SSWU suite. The SSWU
+     3-isogeny constant tables cannot be transcribed here with
+     confidence and no blst/py_ecc exists in the image to validate
+     them against; a sound, deterministic, constant-documented map
+     keeps the scheme secure (hash outputs are indistinguishable from
+     random curve points) at the cost of signature interop with
+     Ethereum-suite signers. Swapping `hash_to_g2` for SSWU restores
+     byte interop without touching anything else.
+  2. SHORT-MESSAGE PADDING: messages of at most 32 bytes are
+     zero-padded to exactly 32 before hashing to the curve
+     (`_fixed_msg`). The reference passes short messages to blst as
+     raw bytes, unpadded — so even with SSWU in place, signatures
+     over messages shorter than 32 bytes would differ from the
+     reference's, and messages differing only in trailing zero bytes
+     within the 32-byte window sign identically here. Consensus
+     messages are always longer than 32 bytes (sha256-hashed on both
+     sides), so the divergence is confined to short ad-hoc payloads.
 
 Everything derivable is DERIVED from the curve parameter x (checked at
 import): r = x^4 - x^2 + 1, p = (x-1)^2/3·r + x, G1 cofactor
@@ -520,8 +531,14 @@ def hash_to_g2(msg: bytes):
 # --- the key type (reference key_bls12381.go surface) -------------------------
 
 def _fixed_msg(msg: bytes) -> bytes:
-    """key_bls12381.go:90-97/133-136: >32 bytes -> sha256; otherwise
-    the raw bytes zero-padded to exactly 32 (Go's [32]byte(msg[:32]))."""
+    """>32 bytes -> sha256 (key_bls12381.go:90-97/133-136); at most 32
+    bytes -> zero-padded to exactly 32. The padding is interop
+    deviation #2 (module docstring): the reference hands short
+    messages to blst raw, unpadded — Go's `[32]byte(msg)` conversion
+    would PANIC for len < 32, so there is no padded-array semantics to
+    match there. Padding makes trailing-zero variants within the
+    32-byte window sign identically, which is acceptable only because
+    consensus sign-bytes are always longer than 32 bytes."""
     if len(msg) > MAX_MSG_LEN:
         return hashlib.sha256(msg).digest()
     return msg.ljust(MAX_MSG_LEN, b"\x00")

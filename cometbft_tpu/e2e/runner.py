@@ -46,8 +46,8 @@ class Manifest:
 
     @classmethod
     def from_toml(cls, text: str) -> "Manifest":
-        import tomllib
-        d = tomllib.loads(text).get("testnet", {})
+        from ..config import loads_flat_toml
+        d = loads_flat_toml(text).get("testnet", {})
         return cls(chain_id=d.get("chain_id", "e2e-net"),
                    validators=int(d.get("validators", 4)),
                    timeout_commit_ms=int(d.get("timeout_commit_ms", 50)),

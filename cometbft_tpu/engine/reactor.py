@@ -139,10 +139,11 @@ class BlocksyncNetReactor:
                 return None
             return max(self._peer_status.values())
 
-    def request_block(self, height: int, timeout: float = 20.0
-                      ) -> Optional[Tuple[Block, str]]:
-        """Blocking fetch from the best-known peer (one bpRequester's
-        work, pool.go:776)."""
+    def request_block_async(self, height: int) -> Optional[Future]:
+        """Send a BlockRequest to the best-known peer and return the
+        Future its response will resolve (None when peerless). The
+        non-blocking half of request_block — simnet's cooperative
+        source polls the future between virtual delivery events."""
         with self._lock:
             candidates = [p for p in self._peers.values()
                           if self._peer_status.get(p.id, 0) + 1 >= height]
@@ -155,6 +156,15 @@ class BlocksyncNetReactor:
             self._pending.setdefault(height, []).append(fut)
         peer.try_send(BLOCKSYNC_CHANNEL,
                       _msg(_BLOCK_REQ, proto.f_varint(1, height)))
+        return fut
+
+    def request_block(self, height: int, timeout: float = 20.0
+                      ) -> Optional[Tuple[Block, str]]:
+        """Blocking fetch from the best-known peer (one bpRequester's
+        work, pool.go:776)."""
+        fut = self.request_block_async(height)
+        if fut is None:
+            return None
         try:
             return fut.result(timeout=timeout)
         except Exception:
@@ -172,6 +182,11 @@ class NetSource:
 
     def max_height(self) -> int:
         self.reactor.broadcast_status_request()
+        # deliberately WALL clock: this sleep-poll loop cannot advance a
+        # virtual clock, so seaming it through libs/timesource would
+        # spin forever under simnet. The simulable form of this wait is
+        # request_block_async + a cooperative pump (simnet's
+        # _SimNetSource implements max_height that way).
         import time
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline:

@@ -74,14 +74,24 @@ class TxIndexer:
 
     def search(self, query: Query, limit: int = 100) -> List[bytes]:
         """Return tx hashes matching ALL conditions (intersection over
-        posting scans — the reference's kv.go Search shape)."""
+        posting scans — the reference's kv.go Search shape), in
+        deterministic (height, idx) chain order: which hashes survive
+        `limit` must not depend on set iteration order."""
         result: Optional[set] = None
         for cond in query.conditions:
             matches = self._scan_condition(cond)
             result = matches if result is None else (result & matches)
             if not result:
                 return []
-        return list(result)[:limit] if result else []
+        if not result:
+            return []
+
+        def chain_pos(txh: bytes):
+            rec = self.get(txh)
+            if rec is None:
+                return (1 << 62, 0, txh)
+            return (rec[0], rec[1], txh)
+        return sorted(result, key=chain_pos)[:limit]
 
     def prune(self, retain_height: int) -> int:
         """Delete tx records and postings below retain_height

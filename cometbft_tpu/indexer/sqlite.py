@@ -83,6 +83,11 @@ class SqliteTxIndexer(_SqliteBase):
             cur.execute(
                 "INSERT OR REPLACE INTO tx_results VALUES (?,?,?,?,?)",
                 (txh, height, index, tx, code))
+            # re-indexing the same tx (reindex_block, crash-replay)
+            # must not accumulate duplicate attribute rows: attributes
+            # have no uniqueness constraint, so drop the old ones first
+            cur.execute("DELETE FROM tx_attributes WHERE hash = ?",
+                        (txh,))
             cur.executemany(
                 "INSERT INTO tx_attributes VALUES (?,?,?,?)",
                 [(tag, str(v), height, txh)
@@ -113,7 +118,25 @@ class SqliteTxIndexer(_SqliteBase):
             result = matches if result is None else (result & matches)
             if not result:
                 return []
-        return list(result)[:limit] if result else []
+        if not result:
+            return []
+        # deterministic chain order BEFORE truncating: which hashes
+        # survive `limit` must not depend on set iteration order. Only
+        # the matched hashes are positioned (chunked under SQLite's
+        # bound-parameter limit), never the whole table.
+        pos = {}
+        hashes = list(result)
+        with self._lock:
+            for i in range(0, len(hashes), 500):
+                chunk = hashes[i:i + 500]
+                rows = self._conn.execute(
+                    "SELECT hash, height, idx FROM tx_results "
+                    f"WHERE hash IN ({','.join('?' * len(chunk))})",
+                    chunk).fetchall()
+                pos.update({bytes(h): (ht, ix) for h, ht, ix in rows})
+        ordered = sorted(result,
+                         key=lambda h: pos.get(h, (1 << 62, 0)) + (h,))
+        return ordered[:limit]
 
     def prune(self, retain_height: int) -> int:
         with self._lock:

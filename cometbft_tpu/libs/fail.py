@@ -6,6 +6,16 @@ Set COMETBFT_TPU_FAIL_INDEX=N (or call set_fail_index) and the Nth
 `fail_point()` crossed in the process exits hard — exercising every
 crash-recovery class (WAL replay, handshake replay, torn files) without
 hand-placed kill timing.
+
+A third mode serves the in-process simulator (cometbft_tpu/simnet):
+`set_fail_hook(fn)` registers a callable invoked at every fail point
+crossing with the point's label. The hook may raise to unwind the
+current node's stack at exactly the label's position — simnet raises
+its `SimCrash` there and models the crash by discarding the node's
+in-memory state while keeping its stores/WAL, the in-process analog of
+the os._exit the env-var modes perform. The env modes take precedence:
+in a process where either is configured, the hook never runs and the
+crossing counters stay exact.
 """
 
 from __future__ import annotations
@@ -47,12 +57,36 @@ def set_fail_label(label: str, k: int = 0) -> None:
         _label_counter = 0
 
 
+# in-process hook (simnet crash schedules); None = disabled. Read
+# without the lock — a single-slot reference swap, and the simulator
+# that installs it is single-threaded by construction.
+_hook = None
+
+
+def set_fail_hook(fn) -> None:
+    """Register fn(label) to run at every fail point crossing. The
+    callable may raise to simulate a crash in-process (simnet)."""
+    global _hook
+    _hook = fn
+
+
+def clear_fail_hook() -> None:
+    global _hook
+    _hook = None
+
+
 def fail_point(label: str = "") -> None:
     """Crash (os._exit, no cleanup — like a power cut) when this is the
     configured failure index, or the k-th crossing of the configured
-    failure label."""
+    failure label. The env-configured crash modes take precedence over
+    a registered hook: while either is armed, crossings feed their
+    counters (and crash at the target) exactly as if no hook existed;
+    the hook receives crossings only in processes with no env mode
+    configured — the simulator's case."""
     global _counter, _label_counter
     if _target < 0 and _label_target is None:
+        if _hook is not None:
+            _hook(label)
         return
     hit = False
     with _lock:

@@ -28,11 +28,19 @@ import os
 import struct
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        ChaCha20Poly1305)
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover — containers without the
+    # cryptography wheel can still import the p2p package (simnet and
+    # the reactors need mconn/switch types only); opening an actual
+    # SecretConnection raises below
+    _HAVE_CRYPTOGRAPHY = False
 
 from ..crypto.keys import Ed25519PrivKey, Ed25519PubKey
 from ..types import proto
@@ -74,6 +82,11 @@ class SecretConnection:
     authentication handshake."""
 
     def __init__(self, sock, priv_key: Ed25519PrivKey):
+        if not _HAVE_CRYPTOGRAPHY:
+            raise HandshakeError(
+                "the 'cryptography' package is required for "
+                "SecretConnection (X25519/ChaCha20); it is not "
+                "installed in this environment")
         self._sock = sock
         self._recv_buf = b""
         eph_priv = X25519PrivateKey.generate()

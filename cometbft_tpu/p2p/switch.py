@@ -29,6 +29,20 @@ class Reactor(Protocol):
     def receive(self, channel_id: int, peer: "Peer", msg: bytes) -> None: ...
 
 
+class PeerLike(Protocol):
+    """The peer surface reactors may rely on (reference p2p/peer.go
+    Peer interface, reduced to what the reactors here actually call).
+    Implementations: `Peer` below (MConnection over a secret TCP
+    connection) and `simnet.transport.SimPeer` (virtual-time in-memory
+    link). Reactors MUST stay inside this surface or the simulator can
+    no longer run them unmodified."""
+
+    id: str
+
+    def send(self, channel_id: int, msg: bytes) -> bool: ...
+    def try_send(self, channel_id: int, msg: bytes) -> bool: ...
+
+
 class Peer:
     """reference p2p/peer.go peer."""
 

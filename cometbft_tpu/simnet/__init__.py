@@ -1,0 +1,20 @@
+"""simnet — deterministic in-process multi-node simulation
+(FoundationDB-style discrete-event simulator over the real node stack).
+
+Entry points:
+  * `scenarios.run_scenario(name, seed)` / `scenarios.sweep(...)`
+  * `tools/sim_run.py` — the CLI (seed sweeps, replayable failures)
+
+See docs/SIMNET.md for the architecture and the virtual-clock seam
+contract reactors must respect to stay simulable.
+"""
+
+from .clock import GENESIS_EPOCH_NS, MS, SimClock, SimCrash, SimTicker
+from .harness import Scenario, SimNode, SimResult, Simulation
+from .transport import LinkPolicy, SimNetwork, SimPeer, SimSwitch
+
+__all__ = [
+    "GENESIS_EPOCH_NS", "MS", "SimClock", "SimCrash", "SimTicker",
+    "Scenario", "SimNode", "SimResult", "Simulation",
+    "LinkPolicy", "SimNetwork", "SimPeer", "SimSwitch",
+]

@@ -1,0 +1,149 @@
+"""Simulation kernel: virtual clock + deterministic event queue +
+the virtual-time consensus ticker.
+
+The whole simulator runs on ONE thread. Time is a number that only
+moves when the event queue pops the next event, so there is no firing
+race, no sleep, and no wall-clock dependence anywhere: two runs that
+schedule the same events in the same order ARE the same run. Ties are
+broken by a monotonically increasing sequence number, which makes heap
+order total and reproducible.
+
+`SimClock.run_until` is reentrant on purpose — a node event may need to
+wait for virtual time to pass (e.g. the cooperative blocksync source in
+harness.py waits for a BlockResponse delivery) and does so by pumping
+the same queue from inside its own event. The nested pump executes
+other nodes' events in exactly the order the outer loop would have.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, List, Optional
+
+from ..consensus.ticker import BaseTicker, TimeoutInfo
+
+# Fixed virtual epoch (2023-11-14T22:13:20Z): every simulation starts
+# here regardless of the host's clock, so vote/block timestamps — which
+# flow into commit hashes via BFT median time — are seed-deterministic.
+GENESIS_EPOCH_NS = 1_700_000_000 * 1_000_000_000
+
+MS = 1_000_000  # ns per millisecond, for readable schedule arithmetic
+
+
+class SimCrash(Exception):
+    """Raised through a node's stack (via libs/fail.py's hook seam) to
+    model a hard crash at exactly a fail point's position. The harness
+    catches it at the node boundary: in-memory state is lost, stores
+    and WAL survive — the in-process analog of fail.py's os._exit."""
+
+    def __init__(self, label: str):
+        super().__init__(label)
+        self.label = label
+
+
+class _Event:
+    __slots__ = ("at_ns", "seq", "fn", "desc", "cancelled")
+
+    def __init__(self, at_ns: int, seq: int, fn: Callable[[], None],
+                 desc: str):
+        self.at_ns = at_ns
+        self.seq = seq
+        self.fn = fn
+        self.desc = desc
+        self.cancelled = False
+
+
+class SimClock:
+    """Discrete-event clock. `time_ns` is the value `libs/timesource`
+    serves while a simulation is running."""
+
+    def __init__(self):
+        self.now_ns = GENESIS_EPOCH_NS
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self.events_run = 0
+
+    def time_ns(self) -> int:
+        return self.now_ns
+
+    def elapsed_ns(self) -> int:
+        return self.now_ns - GENESIS_EPOCH_NS
+
+    def schedule(self, delay_ns: int, fn: Callable[[], None],
+                 desc: str = "") -> _Event:
+        """Run fn at now + delay (>=0). Returns a handle for cancel()."""
+        self._seq += 1
+        ev = _Event(self.now_ns + max(0, int(delay_ns)), self._seq, fn,
+                    desc)
+        heapq.heappush(self._heap, (ev.at_ns, ev.seq, ev))
+        return ev
+
+    @staticmethod
+    def cancel(ev: _Event) -> None:
+        ev.cancelled = True  # lazily discarded when popped
+
+    def _peek(self) -> Optional[_Event]:
+        while self._heap:
+            ev = self._heap[0][2]
+            if ev.cancelled:
+                heapq.heappop(self._heap)
+                continue
+            return ev
+        return None
+
+    def step(self) -> bool:
+        """Advance to and execute the next event; False when drained."""
+        ev = self._peek()
+        if ev is None:
+            return False
+        heapq.heappop(self._heap)
+        self.now_ns = max(self.now_ns, ev.at_ns)
+        self.events_run += 1
+        ev.fn()
+        return True
+
+    def run_until(self, pred: Optional[Callable[[], bool]] = None,
+                  deadline_ns: Optional[int] = None) -> bool:
+        """Pump events until `pred()` holds (returns True), the queue
+        drains, or the next event lies past `deadline_ns` (the clock
+        then jumps to the deadline and this returns pred's value).
+        Reentrant: may be called from inside an event."""
+        while True:
+            if pred is not None and pred():
+                return True
+            nxt = self._peek()
+            if nxt is None:
+                return pred is not None and pred()
+            if deadline_ns is not None and nxt.at_ns > deadline_ns:
+                self.now_ns = max(self.now_ns, deadline_ns)
+                return pred is not None and pred()
+            self.step()
+
+
+class SimTicker(BaseTicker):
+    """Consensus timeout ticker armed on the virtual event queue — the
+    third implementation of consensus/ticker.py's arming seam. The
+    `runner` wraps the fire in the harness's per-node guard (crash
+    capture + inbox drain), so a timeout behaves exactly like any other
+    delivered event."""
+
+    def __init__(self, clock: SimClock, deliver,
+                 runner: Callable[[Callable[[], None]], None]):
+        super().__init__(deliver)
+        self._clock = clock
+        self._runner = runner
+        self._ev: Optional[_Event] = None
+
+    def _arm(self, ti: TimeoutInfo) -> None:
+        self._ev = self._clock.schedule(
+            ti.duration_ms * MS,
+            lambda: self._runner(lambda: self.fire(ti)),
+            desc=f"timeout h={ti.height} r={ti.round} s={ti.step}")
+
+    def _disarm(self) -> None:
+        if self._ev is not None:
+            self._clock.cancel(self._ev)
+            self._ev = None
+
+    def _cleared(self) -> None:
+        self._ev = None

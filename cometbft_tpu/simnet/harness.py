@@ -1,0 +1,636 @@
+"""Node assembly + simulation driver: N REAL nodes in one process on
+virtual time.
+
+Each `SimNode` is wired exactly like `node/node.py`'s boot order for the
+consensus core — app → stores → state-or-genesis → ABCI handshake replay
+→ mempool/evidence → executor → consensus(+WAL) → reactors → switch —
+with the process-level pieces (RPC, indexer service, metrics, threads)
+left out. The consensus state machine is driven through its blessed
+test seam: `handle_msg` is called directly by the event loop instead of
+a receive-routine thread, the ticker arms on the virtual event queue
+(`clock.SimTicker`), and `libs/timesource` serves every `Timestamp.now`
+from the same virtual clock. The result: a multi-node run is a single
+deterministic function of (scenario, seed).
+
+Fault vocabulary:
+  * link faults   — latency/jitter/drop/reorder per link (transport.py)
+  * partitions    — group-based link blocking, heal on schedule
+  * crash-restart — `libs/fail.py` hook raises SimCrash at a chosen
+                    fail-point label; the node loses memory, keeps
+                    stores + WAL + privval state, and reboots through
+                    the same replay path a real process would
+  * byzantine     — per-link message taps forge equivocating votes /
+                    withhold proposals (scenarios.py)
+  * blocksync     — a deferred node joins late and catches up through
+                    the REAL blocksync engine over the simulated wire
+
+Invariant probes (checked during the run and at the end):
+  * agreement     — no two nodes commit different blocks at a height
+  * app-hash      — nodes at the same height hold the same app hash
+  * liveness      — every node reaches the scenario target height by
+                    the virtual deadline (no silent halt)
+  * double-sign   — a DoubleSignError escaping a handler is a violation
+
+The defining property, enforced by tests/test_simnet.py: two runs with
+the same (scenario, seed) produce byte-identical event logs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import queue
+import random
+import shutil
+import tempfile
+import time as _walltime
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..abci.application import RequestFinalizeBlock
+from ..abci.kvstore import KVStoreApplication
+from ..consensus.reactor import (ConsensusReactor, VOTE_CHANNEL,
+                                 _ROUND_STATE)
+from ..consensus.state import (ConsensusConfig, ConsensusState,
+                               STEP_NEW_HEIGHT)
+from ..consensus.ticker import TimeoutInfo
+from ..consensus.wal import WAL
+from ..crypto.keys import Ed25519PrivKey
+from ..db.kv import MemDB
+from ..engine.blocksync import BlocksyncReactor as BlocksyncEngine
+from ..engine.reactor import BlocksyncNetReactor
+from ..evidence.pool import EvidencePool
+from ..evidence.reactor import EvidenceReactor
+from ..libs import fail as libfail
+from ..libs import timesource
+from ..mempool.mempool import CListMempool
+from ..mempool.reactor import MempoolReactor
+from ..privval.file import DoubleSignError, FilePV
+from ..state.execution import BlockExecutor
+from ..state.state import GenesisDoc, State, StateStore
+from ..store.blockstore import BlockStore
+from ..types.block import BlockID
+from ..types.proto import Timestamp
+from ..types.validator import Validator
+from .clock import GENESIS_EPOCH_NS, MS, SimClock, SimCrash, SimTicker
+from .transport import SimNetwork, SimSwitch
+
+# Virtual-time consensus timeouts. timeout_commit paces the chain to
+# ~2.5 heights per virtual second (skip_timeout_commit off, like the
+# reference default) so scenario clocks read naturally and wall cost
+# tracks committed heights, not virtual seconds.
+SIM_CONFIG = ConsensusConfig(
+    timeout_propose=1000, timeout_propose_delta=500,
+    timeout_prevote=500, timeout_prevote_delta=250,
+    timeout_precommit=500, timeout_precommit_delta=250,
+    timeout_commit=400, skip_timeout_commit=False)
+
+RECONCILE_MS = 500  # virtual cadence of the round-state gossip healer
+
+
+@dataclass
+class Scenario:
+    """One bundled fault schedule. `setup(sim)` installs faults/taps and
+    schedules timed actions before any node starts."""
+    name: str
+    description: str
+    target_height: int
+    deadline_ms: int
+    setup: Optional[Callable[["Simulation"], None]] = None
+    n_vals: int = 4
+    quick_target: int = 3
+
+
+@dataclass
+class SimResult:
+    scenario: str
+    seed: int
+    violations: List[str]
+    max_height: int
+    heights: Dict[int, int]
+    app_hashes: Dict[int, str]
+    log_lines: List[str]
+    digest: str
+    wall_s: float
+    virtual_s: float
+    commits_per_sim_s: float
+    crashes: int
+    restarts: int
+    evidence_seen: int
+    errors: List[str]
+    stats: Dict[str, int]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def failure_line(self) -> str:
+        """The replayable one-liner printed on violation."""
+        return (f"SIMNET-FAIL scenario={self.scenario} seed={self.seed} "
+                f"violations={len(self.violations)} "
+                f"first={self.violations[0] if self.violations else ''!r} "
+                f"reproduce: python tools/sim_run.py "
+                f"--scenario {self.scenario} --seed {self.seed}")
+
+
+def make_genesis(n_vals: int, rng: random.Random, chain_id: str):
+    """Deterministic keys + genesis (the tests/cluster.py recipe with a
+    pinned genesis time so nothing depends on the host clock)."""
+    keys = [Ed25519PrivKey.generate(rng) for _ in range(n_vals)]
+    vals = [Validator(k.pub_key(), 10) for k in keys]
+    order = sorted(range(n_vals), key=lambda i: vals[i].address)
+    gen = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=Timestamp(GENESIS_EPOCH_NS // 1_000_000_000, 0),
+        validators=[vals[i] for i in order])
+    return [keys[i] for i in order], gen
+
+
+class SimNode:
+    """One simulated validator. Construction fixes the durable identity
+    (key, stores, WAL path); `boot()` builds the volatile half and can
+    run again after a crash — everything in-memory is rebuilt from the
+    stores exactly like a real process restart."""
+
+    def __init__(self, idx: int, priv_key: Ed25519PrivKey,
+                 gen: GenesisDoc, config: ConsensusConfig, workdir: str):
+        self.idx = idx
+        self.priv_key = priv_key
+        self.node_id = priv_key.pub_key().address().hex()
+        self.gen = gen
+        self.config = config
+        self.block_db = MemDB()
+        self.state_db = MemDB()
+        d = os.path.join(workdir, f"node{idx}")
+        os.makedirs(d, exist_ok=True)
+        self.wal_path = os.path.join(d, "wal")
+        self.pv_state_path = os.path.join(d, "pv.json")
+        self.crashed = False
+        self.booted = False
+        self.started = False
+        self.commits = 0
+
+    def boot(self, sim: "Simulation") -> None:
+        """node/node.py boot order, consensus core only."""
+        self.app = KVStoreApplication()
+        self.block_store = BlockStore(self.block_db)
+        self.state_store = StateStore(self.state_db)
+        state = self.state_store.load()
+        if state is None:
+            state = State.from_genesis(self.gen)
+            self.state_store.save(state)
+        # ABCI handshake: replay stored blocks the (fresh, in-memory)
+        # app has not seen (node.py _handshake)
+        info = self.app.info()
+        if info.last_block_height == 0:
+            self.app.init_chain(self.gen.chain_id, self.gen.initial_height,
+                                self.gen.validators, self.gen.app_state)
+        h = info.last_block_height + 1
+        while h <= state.last_block_height:
+            blk = self.block_store.load_block(h)
+            if blk is None:
+                break
+            self.app.finalize_block(RequestFinalizeBlock(
+                txs=blk.data.txs, height=h, time=blk.header.time,
+                proposer_address=blk.header.proposer_address,
+                hash=blk.hash(),
+                next_validators_hash=blk.header.next_validators_hash))
+            self.app.commit()
+            h += 1
+        self.mempool = CListMempool(
+            lambda tx: (self.app.check_tx(tx).code, 0))
+        self.evidence_pool = EvidencePool(
+            state_store=self.state_store, block_store=self.block_store)
+        self.executor = BlockExecutor(
+            self.app, state_store=self.state_store,
+            block_store=self.block_store, mempool=self.mempool,
+            evidence_pool=self.evidence_pool)
+        if os.path.exists(self.pv_state_path):
+            pv = FilePV.load(self.pv_state_path)
+        else:
+            pv = FilePV(self.priv_key, self.pv_state_path)
+        idx = self.idx
+        self.cs = ConsensusState(
+            self.config, state, self.executor, self.block_store,
+            priv_validator=pv, wal=WAL(self.wal_path),
+            ticker_cls=sim.ticker_factory(idx), name=str(idx))
+        self.cs.evidence_pool = self.evidence_pool
+        self.cs.on_commit = sim.commit_hook(idx)
+        self.switch = SimSwitch(sim.net, idx, self.node_id)
+        sim.net.register(self.switch)
+        self.switch.on_dispatched = lambda: sim.drain(idx)
+        self.consensus_reactor = ConsensusReactor(self.cs)
+        self.consensus_reactor.attach(self.switch)
+        self.blocksync_reactor = BlocksyncNetReactor(self.block_store)
+        self.mempool_reactor = MempoolReactor(self.mempool)
+        self.mempool_reactor.attach(self.switch)
+        self.evidence_reactor = EvidenceReactor(
+            self.evidence_pool, lambda: self.cs.state)
+        self.evidence_reactor.attach(self.switch)
+        for r in (self.consensus_reactor, self.blocksync_reactor,
+                  self.mempool_reactor, self.evidence_reactor):
+            self.switch.add_reactor(r)
+        self.booted = True
+
+    def height(self) -> int:
+        return self.cs.state.last_block_height if self.booted else 0
+
+
+class Simulation:
+    """One (scenario, seed) run."""
+
+    def __init__(self, scenario: Scenario, seed: int,
+                 workdir: Optional[str] = None, quick: bool = False):
+        self.scenario = scenario
+        self.seed = seed
+        self.quick = quick
+        self.target = (min(scenario.target_height, scenario.quick_target)
+                       if quick else scenario.target_height)
+        self._own_workdir = workdir is None
+        self.workdir = workdir or tempfile.mkdtemp(prefix="simnet-")
+        self.clock = SimClock()
+        # ONE seeded PRNG for every random draw (keys, latencies, drops)
+        self.rng = random.Random(f"simnet:{scenario.name}:{seed}")
+        self.log_lines: List[str] = []
+        self.violations: List[str] = []
+        self.errors: List[str] = []
+        self.net = SimNetwork(self.clock, self.rng, self.log)
+        self.net.guard = self.guarded
+        keys, self.gen = make_genesis(
+            scenario.n_vals, self.rng, f"simnet-{scenario.name}")
+        self.nodes = [SimNode(i, k, self.gen, SIM_CONFIG, self.workdir)
+                      for i, k in enumerate(keys)]
+        self.deferred: set = set()
+        self.commit_hashes: Dict[int, str] = {}
+        self.crashes = 0
+        self.restarts = 0
+        self.evidence_seen = 0
+        self._exec_node: Optional[int] = None
+        self._crash_points: Dict[tuple, int] = {}
+        self._restart_after: Dict[int, int] = {}
+
+    # --- logging / invariants ---------------------------------------------
+
+    def log(self, kind: str, **kw) -> None:
+        t = self.clock.elapsed_ns()
+        fields = " ".join(f"{k}={v}" for k, v in kw.items())
+        self.log_lines.append(f"{t:>12} {kind} {fields}".rstrip())
+
+    def violation(self, msg: str) -> None:
+        self.log("violation", msg=msg.replace(" ", "_"))
+        self.violations.append(msg)
+
+    def digest(self) -> str:
+        h = hashlib.sha256()
+        for line in self.log_lines:
+            h.update(line.encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def commit_hook(self, idx: int):
+        def on_commit(block, commit):
+            h = block.header.height
+            bh = block.hash().hex()
+            node = self.nodes[idx]
+            node.commits += 1
+            n_ev = len(block.evidence or [])
+            self.evidence_seen += n_ev
+            self.log("commit", node=idx, h=h, b=bh[:16],
+                     txs=len(block.data.txs), ev=n_ev)
+            prev = self.commit_hashes.get(h)
+            if prev is None:
+                self.commit_hashes[h] = bh
+            elif prev != bh:
+                self.violation(
+                    f"conflicting commits at height {h}: "
+                    f"{prev[:16]} vs {bh[:16]} (node {idx})")
+        return on_commit
+
+    # --- node-code execution guard ----------------------------------------
+
+    def guarded(self, idx: int, thunk: Callable[[], None]) -> None:
+        """Run node `idx`'s code: set the fail-hook context, drain its
+        consensus inbox afterwards, convert SimCrash into a modeled
+        crash, and keep the simulation alive through handler errors
+        (the real switch/receive-routine posture)."""
+        node = self.nodes[idx]
+        if node.crashed or not node.booted:
+            return
+        prev = self._exec_node
+        self._exec_node = idx
+        try:
+            thunk()
+            self.drain(idx)
+        except SimCrash as c:
+            self.log("crash", node=idx, label=c.label,
+                     h=node.height())
+            self._do_crash(idx)
+        except DoubleSignError as e:
+            self.violation(f"double-sign refused on node {idx}: {e}")
+        except Exception as e:  # noqa: BLE001 — a node bug must surface
+            # in `errors`, not kill the other simulated nodes
+            self.log("node_error", node=idx, err=type(e).__name__)
+            self.errors.append(f"node {idx}: {e!r}")
+        finally:
+            self._exec_node = prev
+
+    def drain(self, idx: int) -> None:
+        """Deliver everything queued in the node's consensus inbox (the
+        single-writer loop's work, run inline on the sim thread)."""
+        cs = self.nodes[idx].cs
+        while True:
+            try:
+                msg = cs.inbox.get_nowait()
+            except queue.Empty:
+                return
+            if msg is None:
+                continue
+            m, pid = msg if isinstance(msg, tuple) else (msg, "")
+            try:
+                cs.handle_msg(m, pid)
+            except (SimCrash, DoubleSignError):
+                raise
+            except Exception as e:  # noqa: BLE001 — bad peer msg parity
+                # with receive_routine: log, keep the loop alive
+                self.log("handler_error", node=idx,
+                         err=type(e).__name__)
+                self.errors.append(f"node {idx} handler: {e!r}")
+
+    def ticker_factory(self, idx: int):
+        def factory(deliver):
+            def logged_deliver(ti: TimeoutInfo):
+                self.log("timeout", node=idx, h=ti.height, r=ti.round,
+                         s=ti.step)
+                deliver(ti)
+            return SimTicker(self.clock, logged_deliver,
+                             runner=lambda thunk: self.guarded(idx, thunk))
+        return factory
+
+    # --- fault schedule ----------------------------------------------------
+
+    def at(self, ms: int, fn: Callable[[], None], desc: str = "") -> None:
+        """Schedule a scenario action at virtual millisecond `ms`."""
+        self.clock.schedule(ms * MS, fn, desc=desc or "scenario-action")
+
+    def defer(self, idx: int) -> None:
+        """Keep node `idx` offline at start (blocksync join scenarios)."""
+        self.deferred.add(idx)
+
+    def crash_at_label(self, idx: int, label: str, k: int = 0,
+                       restart_after_ms: int = 1500) -> None:
+        """Crash node `idx` at the k-th crossing of fail-point `label`,
+        restart it `restart_after_ms` later. One-shot: the same point
+        cannot re-fire during replay (no crash loops)."""
+        self._crash_points[(idx, label)] = k
+        self._restart_after[idx] = restart_after_ms
+
+    def _fail_hook(self, label: str) -> None:
+        idx = self._exec_node
+        if idx is None:
+            return
+        left = self._crash_points.get((idx, label))
+        if left is None:
+            return
+        if left > 0:
+            self._crash_points[(idx, label)] = left - 1
+            return
+        del self._crash_points[(idx, label)]
+        raise SimCrash(label)
+
+    def _do_crash(self, idx: int) -> None:
+        node = self.nodes[idx]
+        node.crashed = True
+        node.started = False
+        self.crashes += 1
+        self.net.crash(idx)
+        try:
+            node.cs.ticker.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            node.cs.wal.close()
+        except Exception:  # noqa: BLE001
+            pass
+        restart_ms = self._restart_after.pop(idx, None)
+        if restart_ms is not None:
+            self.clock.schedule(restart_ms * MS,
+                                lambda: self._do_restart(idx),
+                                desc=f"restart node {idx}")
+
+    def _do_restart(self, idx: int) -> None:
+        node = self.nodes[idx]
+        node.crashed = False
+        self.restarts += 1
+
+        def thunk():
+            node.boot(self)
+            self.net.restart(idx)
+            self.log("restart", node=idx, h=node.height())
+            self._start_consensus(node)
+        self.guarded(idx, thunk)
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def _start_consensus(self, node: SimNode) -> None:
+        node.cs.catchup_replay()
+        node.started = True
+        node.cs.ticker.schedule(TimeoutInfo(
+            0, node.cs.rs.height, 0, STEP_NEW_HEIGHT))
+
+    def _schedule_reconcile(self, idx: int) -> None:
+        """The periodic round-state gossip healer — the virtual-time
+        analog of ConsensusReactor.start_reconciler's thread, staggered
+        per node so broadcasts never collide on one instant."""
+        def tick():
+            node = self.nodes[idx]
+            if node.booted and not node.crashed and node.started:
+                def do():
+                    msg = node.consensus_reactor._snapshot_round_state()
+                    node.switch.broadcast(
+                        VOTE_CHANNEL, bytes([_ROUND_STATE]) + msg.encode())
+                self.guarded(idx, do)
+            self.clock.schedule(RECONCILE_MS * MS, tick, desc="reconcile")
+        self.clock.schedule((RECONCILE_MS + 7 * idx) * MS, tick,
+                            desc="reconcile")
+
+    def inject_txs(self, every_ms: int = 300, count: int = 8) -> None:
+        """Feed deterministic txs round-robin so blocks carry data and
+        the app hash actually evolves."""
+        def make(i: int):
+            def fire():
+                idx = i % len(self.nodes)
+                node = self.nodes[idx]
+                if not node.booted or node.crashed:
+                    return
+                tx = f"k{i}={self.seed}-{i}".encode()
+
+                def do():
+                    try:
+                        node.mempool.check_tx(tx)
+                    except ValueError:
+                        pass  # full/duplicate: drop like RPC would
+                self.guarded(idx, do)
+            return fire
+        for i in range(count):
+            self.clock.schedule((200 + i * every_ms) * MS, make(i),
+                                desc="inject-tx")
+
+    def _done(self) -> bool:
+        return all(n.started and not n.crashed
+                   and n.height() >= self.target for n in self.nodes)
+
+    def _final_checks(self) -> None:
+        if not self._done():
+            for n in self.nodes:
+                if n.crashed or not n.started:
+                    self.violation(
+                        f"halt: node {n.idx} down at deadline "
+                        f"(h={n.height()})")
+                elif n.height() < self.target:
+                    self.violation(
+                        f"halt: node {n.idx} at height {n.height()} < "
+                        f"target {self.target} at deadline")
+        by_height: Dict[int, set] = {}
+        for n in self.nodes:
+            if n.booted and not n.crashed:
+                by_height.setdefault(
+                    n.height(), set()).add(n.cs.state.app_hash)
+        for h, hashes in sorted(by_height.items()):
+            if len(hashes) > 1:
+                self.violation(f"app hash divergence at height {h}")
+
+    def run(self) -> SimResult:
+        t0 = _walltime.perf_counter()
+        timesource.install(self.clock.time_ns)
+        libfail.set_fail_hook(self._fail_hook)
+        try:
+            self.log("start", scenario=self.scenario.name, seed=self.seed,
+                     n=len(self.nodes), target=self.target)
+            if self.scenario.setup is not None:
+                self.scenario.setup(self)
+            self.inject_txs()
+            for node in self.nodes:
+                node.boot(self)
+            for a in self.nodes:
+                if a.idx in self.deferred:
+                    continue
+                for b in self.nodes:
+                    if b.idx != a.idx and b.idx not in self.deferred:
+                        a.switch.connect(b.idx, b.node_id)
+            for node in self.nodes:
+                if node.idx not in self.deferred:
+                    self.guarded(node.idx,
+                                 lambda n=node: self._start_consensus(n))
+            for node in self.nodes:
+                self._schedule_reconcile(node.idx)
+            deadline = GENESIS_EPOCH_NS + self.scenario.deadline_ms * MS
+            self.clock.run_until(
+                lambda: bool(self.violations) or self._done(),
+                deadline_ns=deadline)
+            self._final_checks()
+        finally:
+            libfail.clear_fail_hook()
+            timesource.reset()
+            for node in self.nodes:
+                if node.booted:
+                    try:
+                        node.cs.wal.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+            if self._own_workdir:
+                shutil.rmtree(self.workdir, ignore_errors=True)
+        virtual_s = self.clock.elapsed_ns() / 1e9
+        max_h = max(self.commit_hashes) if self.commit_hashes else 0
+        self.log("end", max_h=max_h, commits=sum(
+            n.commits for n in self.nodes),
+            delivered=self.net.delivered, dropped=self.net.dropped,
+            blocked=self.net.blocked, crashes=self.crashes,
+            restarts=self.restarts, violations=len(self.violations))
+        return SimResult(
+            scenario=self.scenario.name, seed=self.seed,
+            violations=self.violations, max_height=max_h,
+            heights={n.idx: n.height() for n in self.nodes},
+            app_hashes={n.idx: (n.cs.state.app_hash.hex()
+                                if n.booted else "")
+                        for n in self.nodes},
+            log_lines=self.log_lines, digest=self.digest(),
+            wall_s=_walltime.perf_counter() - t0, virtual_s=virtual_s,
+            commits_per_sim_s=(max_h / virtual_s if virtual_s else 0.0),
+            crashes=self.crashes, restarts=self.restarts,
+            evidence_seen=self.evidence_seen, errors=self.errors,
+            stats={"delivered": self.net.delivered,
+                   "dropped": self.net.dropped,
+                   "blocked": self.net.blocked,
+                   "events": self.clock.events_run})
+
+    # --- cooperative blocksync (lagging-node catch-up) ---------------------
+
+    def blocksync_join(self, idx: int) -> None:
+        """Bring a deferred node online: connect it, run the REAL
+        blocksync engine over the simulated wire (native verify path),
+        then hand over to consensus — node.py's blocksync-then-consensus
+        boot, cooperatively scheduled."""
+        node = self.nodes[idx]
+
+        def thunk():
+            self.net.restart(idx)
+            self.log("join", node=idx)
+            source = _SimNetSource(self, node)
+            target = source.max_height()
+            state = node.cs.state
+            if target > state.last_block_height:
+                engine = BlocksyncEngine(
+                    node.executor, node.block_store, source,
+                    self.gen.chain_id, tile_size=4, batch_size=0)
+                try:
+                    state = engine.sync(state, target)
+                except Exception as e:  # noqa: BLE001 — type name only:
+                    # exception text may embed run-dependent reprs, and
+                    # violation lines are part of the deterministic log
+                    self.violation(f"blocksync failed on node {idx}: "
+                                   f"{type(e).__name__}")
+                    return
+                self.log("blocksync", node=idx,
+                         h=state.last_block_height,
+                         applied=engine.stats.blocks_applied)
+                if state is not node.cs.state:
+                    node.cs.state = state
+                    node.cs._update_to_state(state)
+            self._start_consensus(node)
+        self.guarded(idx, thunk)
+
+
+class _SimNetSource:
+    """engine.blocksync.PeerSource over the simulated wire: each fetch
+    sends a real BlockRequest and pumps the event queue (reentrantly)
+    until the response delivery resolves it or virtual time runs out."""
+
+    FETCH_TIMEOUT_MS = 2000
+
+    def __init__(self, sim: Simulation, node: SimNode):
+        self.sim = sim
+        self.node = node
+
+    def _wait(self, pred) -> bool:
+        deadline = self.sim.clock.now_ns + self.FETCH_TIMEOUT_MS * MS
+        return self.sim.clock.run_until(pred, deadline_ns=deadline)
+
+    def max_height(self) -> int:
+        r = self.node.blocksync_reactor
+        r.broadcast_status_request()
+        self._wait(lambda: r.max_peer_height() is not None)
+        return r.max_peer_height() or 0
+
+    def fetch(self, height: int):
+        fut = self.node.blocksync_reactor.request_block_async(height)
+        if fut is None:
+            return None
+        if not self._wait(fut.done):
+            return None
+        got = fut.result()
+        if got is None:
+            return None
+        return got[0], BlockID()
+
+    def ban(self, height: int) -> None:
+        self.sim.log("blocksync_ban", node=self.node.idx, h=height)

@@ -1,0 +1,270 @@
+"""In-memory simulated transport: the reactor-facing p2p surface
+(`p2p.switch.PeerLike` peers + the Switch dispatch contract) over the
+virtual event queue instead of sockets.
+
+Real reactors — consensus, mempool, evidence, blocksync — run UNMODIFIED
+on top of this: they see peers with `id`/`send`/`try_send`, broadcast
+through a switch, and receive wire bytes via `receive(channel, peer,
+raw)`, exactly as over `p2p.switch.Switch`. What changes is the medium:
+every message crosses a link with seeded latency/jitter/drop/reorder,
+partitions block links between groups, and crashed nodes neither send
+nor receive. All randomness comes from ONE `random.Random(seed)` owned
+by the harness, drawn in event order — the whole fault schedule is a
+pure function of the seed.
+
+Byzantine behavior lives here too: `taps` may rewrite or suppress a
+message per (src, dst) link — how the bundled byzantine-proposer
+scenario forges equivocating votes and selectively withholds proposals
+without touching the (honest) consensus code under test.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .clock import SimClock
+
+
+def digest8(raw: bytes) -> str:
+    """Short stable content digest for event-log lines."""
+    return hashlib.sha256(raw).hexdigest()[:8]
+
+
+@dataclass
+class LinkPolicy:
+    """Per-directed-link delivery behavior. Latency draws uniformly in
+    [latency_ns, latency_ns + jitter_ns); `reorder` adds a burst of
+    extra delay so a later message can overtake this one."""
+    latency_ns: int = 10_000_000          # 10ms
+    jitter_ns: int = 5_000_000            # +0..5ms
+    drop: float = 0.0
+    reorder: float = 0.0
+    reorder_extra_ns: int = 40_000_000
+
+
+class SimPeer:
+    """`p2p.switch.PeerLike`: node `remote` as seen from node `local`."""
+
+    __slots__ = ("net", "local", "remote", "id")
+
+    def __init__(self, net: "SimNetwork", local: int, remote: int,
+                 node_id: str):
+        self.net = net
+        self.local = local
+        self.remote = remote
+        self.id = node_id
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        return self.net.send(self.local, self.remote, channel_id, msg)
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        return self.try_send(channel_id, msg)
+
+    def __repr__(self) -> str:
+        return f"SimPeer{{{self.local}->{self.remote}}}"
+
+
+class SimSwitch:
+    """The reactor-facing Switch surface (`add_reactor` / `broadcast` /
+    `peers` / `stop_peer` / channel dispatch) for one simulated node."""
+
+    def __init__(self, net: "SimNetwork", idx: int, node_id: str):
+        self.net = net
+        self.idx = idx
+        self.node_id = node_id
+        self._reactors: List[object] = []
+        self._chan_to_reactor: Dict[int, object] = {}
+        self._peers: Dict[int, SimPeer] = {}
+        # harness hook: runs after every successful dispatch (drains the
+        # consensus inbox so reactor->cs.send messages are processed in
+        # the same virtual instant they arrive)
+        self.on_dispatched: Callable[[], None] = lambda: None
+
+    # --- setup (mirrors p2p.switch.Switch) --------------------------------
+
+    def add_reactor(self, reactor) -> None:
+        for d in reactor.get_channels():
+            if d.id in self._chan_to_reactor:
+                raise ValueError(f"channel {d.id:#x} already claimed")
+            self._chan_to_reactor[d.id] = reactor
+        self._reactors.append(reactor)
+
+    # --- peer lifecycle ---------------------------------------------------
+
+    def connect(self, remote: int, node_id: str) -> None:
+        """Create the peer and run every reactor's add_peer hook (vote
+        replay, mempool/evidence replay, blocksync status request) —
+        the simulated analog of a completed handshake."""
+        if remote in self._peers:
+            return
+        peer = SimPeer(self.net, self.idx, remote, node_id)
+        self._peers[remote] = peer
+        for r in self._reactors:
+            r.add_peer(peer)
+
+    def disconnect(self, remote: int, reason: str) -> None:
+        peer = self._peers.pop(remote, None)
+        if peer is None:
+            return
+        for r in self._reactors:
+            r.remove_peer(peer, reason)
+
+    def peers(self) -> List[SimPeer]:
+        return [self._peers[k] for k in sorted(self._peers)]
+
+    def stop_peer(self, peer: SimPeer, reason: str,
+                  ban: bool = False) -> None:
+        # sanitize: reasons are caller-controlled text, but the event
+        # log must stay in k=v grammar (no spaces) and byte-identical
+        # across same-seed runs — callers must not embed reprs
+        self.net.log("stop_peer", node=self.idx, peer=peer.remote,
+                     reason=reason.replace(" ", "_"))
+        self.disconnect(peer.remote, reason)
+
+    # --- dispatch ---------------------------------------------------------
+
+    def broadcast(self, channel_id: int, msg: bytes) -> None:
+        for peer in self.peers():
+            peer.try_send(channel_id, msg)
+
+    def deliver(self, src: int, channel_id: int, raw: bytes) -> None:
+        peer = self._peers.get(src)
+        if peer is None:
+            return  # sender was dropped while the message was in flight
+        reactor = self._chan_to_reactor.get(channel_id)
+        if reactor is None:
+            self.stop_peer(peer, f"unclaimed channel {channel_id:#x}")
+            return
+        try:
+            reactor.receive(channel_id, peer, raw)
+        except Exception as e:  # noqa: BLE001 — the real switch's
+            # posture: a reactor error drops the offending peer, not the
+            # node. Injected crashes/double-signs unwind to the harness.
+            from ..privval.file import DoubleSignError
+            from .clock import SimCrash
+            if isinstance(e, (SimCrash, DoubleSignError)):
+                raise
+            # type name only: exception text can embed object reprs
+            # whose addresses differ between same-seed runs
+            self.stop_peer(peer, f"reactor_error:{type(e).__name__}")
+            return
+        self.on_dispatched()
+
+
+class SimNetwork:
+    """Links, partitions, and crash liveness for N simulated nodes."""
+
+    def __init__(self, clock: SimClock, rng, log_fn: Callable[..., None]):
+        self.clock = clock
+        self.rng = rng
+        self.log = log_fn
+        self.default_policy = LinkPolicy()
+        self._links: Dict[Tuple[int, int], LinkPolicy] = {}
+        self.switches: List[SimSwitch] = []
+        self._groups: Optional[List[set]] = None
+        self.crashed: set = set()
+        self.dropped = 0
+        self.delivered = 0
+        self.blocked = 0
+        # per-link message rewriters: fn(src, dst, ch, raw) -> bytes
+        # replacement, or None to suppress (byzantine scenarios)
+        self.taps: List[Callable[[int, int, int, bytes],
+                                 Optional[bytes]]] = []
+        # harness guard executing node-side code (crash capture + inbox
+        # drain); identity by default so the transport is testable alone
+        self.guard: Callable[[int, Callable[[], None]], None] = \
+            lambda idx, thunk: thunk()
+
+    def register(self, switch: SimSwitch) -> None:
+        """First boot appends; a reboot replaces the node's switch (the
+        old one died with the crashed process image)."""
+        if switch.idx == len(self.switches):
+            self.switches.append(switch)
+        else:
+            self.switches[switch.idx] = switch
+
+    # --- topology controls ------------------------------------------------
+
+    def set_link(self, src: int, dst: int, policy: LinkPolicy) -> None:
+        self._links[(src, dst)] = policy
+
+    def policy(self, src: int, dst: int) -> LinkPolicy:
+        return self._links.get((src, dst), self.default_policy)
+
+    def set_partition(self, groups: List[List[int]]) -> None:
+        """Nodes in different groups cannot exchange messages; a node in
+        no group is isolated from everyone."""
+        self._groups = [set(g) for g in groups]
+        self.log("partition", groups="|".join(
+            ",".join(str(i) for i in sorted(g)) for g in self._groups))
+
+    def heal(self) -> None:
+        self._groups = None
+        self.log("heal")
+
+    def partitioned(self, a: int, b: int) -> bool:
+        if self._groups is None:
+            return False
+        return not any(a in g and b in g for g in self._groups)
+
+    # --- the data path ----------------------------------------------------
+
+    def send(self, src: int, dst: int, channel_id: int,
+             raw: bytes) -> bool:
+        """try_send semantics: True means accepted for (attempted)
+        delivery; loss happens silently downstream, like a socket."""
+        if src in self.crashed or dst in self.crashed:
+            self.blocked += 1
+            return False
+        if self.partitioned(src, dst):
+            self.blocked += 1
+            return True  # the sender cannot tell; packets just vanish
+        for tap in self.taps:
+            raw = tap(src, dst, channel_id, raw)
+            if raw is None:
+                return True
+        pol = self.policy(src, dst)
+        if pol.drop > 0.0 and self.rng.random() < pol.drop:
+            self.dropped += 1
+            return True
+        delay = pol.latency_ns
+        if pol.jitter_ns > 0:
+            delay += self.rng.randrange(pol.jitter_ns)
+        if pol.reorder > 0.0 and self.rng.random() < pol.reorder:
+            delay += pol.reorder_extra_ns
+        self.clock.schedule(
+            delay, lambda: self._deliver(src, dst, channel_id, raw),
+            desc=f"deliver {src}->{dst} ch={channel_id:#x}")
+        return True
+
+    def _deliver(self, src: int, dst: int, channel_id: int,
+                 raw: bytes) -> None:
+        if src in self.crashed or dst in self.crashed:
+            return  # endpoint died while the message was in flight
+        self.delivered += 1
+        self.log("deliver", src=src, dst=dst, ch=f"{channel_id:#x}",
+                 n=len(raw), d=digest8(raw))
+        self.guard(dst, lambda: self.switches[dst].deliver(
+            src, channel_id, raw))
+
+    # --- crash / restart --------------------------------------------------
+
+    def crash(self, idx: int) -> None:
+        self.crashed.add(idx)
+        for other, sw in enumerate(self.switches):
+            if other != idx:
+                sw.disconnect(idx, "peer crashed")
+        self.switches[idx]._peers.clear()
+
+    def restart(self, idx: int) -> None:
+        """Reconnect idx with every alive node, both directions, in
+        index order (deterministic add_peer hook order)."""
+        self.crashed.discard(idx)
+        me = self.switches[idx]
+        for other, sw in enumerate(self.switches):
+            if other == idx or other in self.crashed:
+                continue
+            me.connect(other, sw.node_id)
+            sw.connect(idx, me.node_id)

@@ -209,8 +209,11 @@ class Timestamp:
 
     @classmethod
     def now(cls) -> "Timestamp":
-        import time
-        t = time.time_ns()
+        # read through the time seam: under simnet's virtual clock every
+        # in-process node stamps votes/blocks from the same deterministic
+        # source (libs/timesource.py); live nodes get time.time_ns
+        from ..libs import timesource
+        t = timesource.time_ns()
         return cls(t // 1_000_000_000, t % 1_000_000_000)
 
     @classmethod

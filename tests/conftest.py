@@ -24,7 +24,15 @@ import jax
 # check (SIGILL risk; mesh executables outright segfault), so every
 # test run recompiles its kernels (minutes per variant, per process).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the 0.4.x mechanism
+    # is the XLA host-platform flag, which is read at backend
+    # initialization — still ahead of us even though jax itself is
+    # pre-imported, as long as nothing has called jax.devices() yet
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from cometbft_tpu.libs.jax_cache import enable_compile_cache  # noqa: E402
@@ -35,3 +43,5 @@ enable_compile_cache()
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / perturbation tests")
+    config.addinivalue_line(
+        "markers", "sim: deterministic simnet scenarios (virtual time)")

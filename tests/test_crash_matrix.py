@@ -13,9 +13,17 @@ Fail points crossed per commit, in order:
   5 apply_block:post-save-response (before app commit/state save)
 """
 
+import pytest
+
+# the real TCP stack rides SecretConnection (X25519/ChaCha20);
+# containers without the cryptography wheel skip these — the
+# in-process cluster and simnet suites cover the same protocol
+# logic over crypto-free transports
+pytest.importorskip("cryptography")
+
+
 import time
 
-import pytest
 
 from cometbft_tpu.e2e.runner import Manifest, Testnet
 

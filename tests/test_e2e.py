@@ -3,9 +3,17 @@ blocks, surviving a kill+restart perturbation, serving txs — the
 test/e2e ci-manifest shape (reference test/e2e/networks/ci.toml,
 runner/perturb.go, tests/block_test.go)."""
 
+import pytest
+
+# the real TCP stack rides SecretConnection (X25519/ChaCha20);
+# containers without the cryptography wheel skip these — the
+# in-process cluster and simnet suites cover the same protocol
+# logic over crypto-free transports
+pytest.importorskip("cryptography")
+
+
 import time
 
-import pytest
 
 from cometbft_tpu.e2e.runner import Manifest, Testnet
 

@@ -126,6 +126,7 @@ def test_fuzz_secret_connection_frames():
     """Corrupted ciphertext frames must kill the connection with a clean
     error — never hang or crash (reference test/fuzz/p2p/secretconnection).
     """
+    pytest.importorskip("cryptography")
     from cometbft_tpu.p2p.conn import SecretConnection, HandshakeError
 
     a_sock, b_sock = socket.socketpair()

@@ -3,11 +3,19 @@ multiplexing/priorities, Switch handshake + dispatch over real localhost
 TCP sockets (reference p2p/conn/secret_connection_test.go,
 connection_test.go, switch_test.go)."""
 
+import pytest
+
+# the real TCP stack rides SecretConnection (X25519/ChaCha20);
+# containers without the cryptography wheel skip these — the
+# in-process cluster and simnet suites cover the same protocol
+# logic over crypto-free transports
+pytest.importorskip("cryptography")
+
+
 import socket
 import threading
 import time
 
-import pytest
 
 from cometbft_tpu.crypto.keys import Ed25519PrivKey
 from cometbft_tpu.p2p.conn import SecretConnection, HandshakeError

@@ -7,9 +7,17 @@ Uses the same (4 validators, batch 64) kernel bucket as test_blocksync so
 the compile cache is shared.
 """
 
+import pytest
+
+# the real TCP stack rides SecretConnection (X25519/ChaCha20);
+# containers without the cryptography wheel skip these — the
+# in-process cluster and simnet suites cover the same protocol
+# logic over crypto-free transports
+pytest.importorskip("cryptography")
+
+
 import time
 
-import pytest
 
 from cometbft_tpu.abci.kvstore import KVStoreApplication
 from cometbft_tpu.crypto.keys import Ed25519PrivKey

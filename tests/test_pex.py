@@ -1,6 +1,15 @@
 """PEX: address book persistence and gossip-driven mesh formation
 (reference p2p/pex/pex_reactor_test.go, addrbook_test.go)."""
 
+import pytest
+
+# the real TCP stack rides SecretConnection (X25519/ChaCha20);
+# containers without the cryptography wheel skip these — the
+# in-process cluster and simnet suites cover the same protocol
+# logic over crypto-free transports
+pytest.importorskip("cryptography")
+
+
 import time
 
 from cometbft_tpu.crypto.keys import Ed25519PrivKey

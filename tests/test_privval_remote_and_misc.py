@@ -19,6 +19,8 @@ from cometbft_tpu.types.vote import Proposal, Vote, PREVOTE_TYPE
 
 
 def test_remote_signer_end_to_end(tmp_path):
+    # the remote signer link is a SecretConnection (X25519/ChaCha20)
+    pytest.importorskip("cryptography")
     pv = FilePV.generate(str(tmp_path / "pv.json"))
     pv._save()
     client = SignerClient()
@@ -97,6 +99,7 @@ def test_fail_point_crashes_process(tmp_path):
 
 
 def test_armor_roundtrip_and_rejections():
+    pytest.importorskip("cryptography")  # armoring AEAD
     key = Ed25519PrivKey.generate()
     armored = encrypt_armor_privkey(key.seed, "ed25519", "hunter2")
     assert "BEGIN COMETBFT_TPU PRIVATE KEY" in armored

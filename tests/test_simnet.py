@@ -1,0 +1,75 @@
+"""simnet: the deterministic in-process multi-node simulator
+(cometbft_tpu/simnet, docs/SIMNET.md).
+
+The defining property — same seed => byte-identical event log — is
+pinned here, along with seed divergence, crash-restart WAL replay
+convergence, byzantine equivocation evidence flow, and a fast
+seed-sweep smoke across the whole scenario catalog. The 100-seed
+sweep is slow-marked; CI runs the quick versions.
+"""
+
+import pytest
+
+from cometbft_tpu.simnet.scenarios import SCENARIOS, run_scenario, sweep
+
+pytestmark = pytest.mark.sim
+
+
+def test_same_seed_identical_event_log():
+    a = run_scenario("partition-heal", 11, quick=True)
+    b = run_scenario("partition-heal", 11, quick=True)
+    assert a.ok, a.violations
+    assert a.digest == b.digest
+    assert a.log_lines == b.log_lines
+    assert a.max_height >= 3
+
+
+def test_different_seeds_diverge():
+    a = run_scenario("baseline", 1, quick=True)
+    b = run_scenario("baseline", 2, quick=True)
+    assert a.ok and b.ok
+    assert a.digest != b.digest
+
+
+def test_crash_restart_replays_wal_to_same_app_hash():
+    r = run_scenario("crash-restart", 5, quick=True)
+    assert r.ok, r.violations
+    assert r.crashes == 1 and r.restarts == 1
+    # the restarted node converged: nodes at equal heights hold equal
+    # app hashes (also invariant-checked inside the run)
+    by_h = {}
+    for idx, h in r.heights.items():
+        by_h.setdefault(h, set()).add(r.app_hashes[idx])
+    assert all(len(hashes) == 1 for hashes in by_h.values())
+
+
+def test_byzantine_equivocation_produces_evidence():
+    r = run_scenario("byzantine-proposer", 3, quick=True)
+    assert r.ok, r.violations
+    # the forged duplicate votes must surface as committed evidence
+    assert r.evidence_seen > 0
+
+
+def test_blocksync_lag_catches_up():
+    r = run_scenario("blocksync-lag", 1, quick=True)
+    assert r.ok, r.violations
+    assert any("blocksync" in line for line in r.log_lines)
+
+
+def test_seed_sweep_smoke():
+    """Fast tier-1 sweep (<=20s CPU): one quick seed through each of
+    the four headline fault classes. The full catalog runs in the
+    slow-marked 100-seed sweep and in `tools/sim_run.py --selftest`."""
+    names = ["partition-heal", "crash-restart", "byzantine-proposer",
+             "blocksync-lag"]
+    results = [run_scenario(n, seed=20 + i, quick=True)
+               for i, n in enumerate(names)]
+    bad = [r for r in results if not r.ok]
+    assert not bad, [r.failure_line() for r in bad]
+
+
+@pytest.mark.slow
+def test_seed_sweep_100():
+    results = sweep(range(100), scenario="all", quick=True)
+    bad = [r for r in results if not r.ok]
+    assert not bad, [r.failure_line() for r in bad]

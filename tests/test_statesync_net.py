@@ -2,6 +2,15 @@
 peer, streams chunks over the chunk channel, and restores with the
 light-client anchor (reference internal/statesync/reactor_test.go)."""
 
+import pytest
+
+# the real TCP stack rides SecretConnection (X25519/ChaCha20);
+# containers without the cryptography wheel skip these — the
+# in-process cluster and simnet suites cover the same protocol
+# logic over crypto-free transports
+pytest.importorskip("cryptography")
+
+
 import time
 
 from cometbft_tpu.abci.kvstore import KVStoreApplication
@@ -67,7 +76,6 @@ def test_statesync_over_tcp():
         sw_b.stop()
 
 
-import pytest
 
 
 @pytest.mark.slow

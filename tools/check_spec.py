@@ -122,6 +122,17 @@ class Model:
         def rep(t, i, x):
             return t[:i] + (x,) + t[i + 1:]
 
+        # ByzantinePropose(r, x): a Byzantine proposer may broadcast any
+        # value for its round at any time (round 3 under the default
+        # n=4/f=1 rotation). Without this action, props[r] stays Nil in
+        # Byzantine-proposer rounds and correct validators can only
+        # prevote nil/locked — a strictly smaller transition system than
+        # spec/Consensus.tla (ADVICE round 5 medium).
+        for r in self.rounds:
+            if self.proposer(r) >= self.correct and props[r] == NIL:
+                for x in self.values:
+                    emit(props=rep(props, r, x))
+
         for v in range(self.correct):
             r = rounds[v]
 

@@ -21,4 +21,6 @@ echo "=== suite 1/2: ${#FIRST[@]} modules (a-o) ===" >&2
 python -m pytest "${FIRST[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
 echo "=== suite 2/2: ${#SECOND[@]} modules (p-z) ===" >&2
 python -m pytest "${SECOND[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
+echo "=== simnet selftest (determinism + crash recovery) ===" >&2
+python tools/sim_run.py --selftest || rc=$?
 exit $rc

@@ -1,0 +1,146 @@
+"""simnet CLI — run deterministic multi-node simulations from seeds.
+
+Usage:
+  python tools/sim_run.py --seed 42 --scenario partition-heal
+      One run. stdout is EXACTLY the event log plus one deterministic
+      summary line — run it twice, diff nothing (the acceptance check
+      pipes both runs to files and compares bytes). Wall-clock notes go
+      to stderr so they can't perturb the log.
+
+  python tools/sim_run.py --seeds 0..24 [--scenario all]
+      Seed sweep. With --scenario all (default) the bundled scenarios
+      are assigned round-robin by seed, so a range covers the whole
+      catalog; every line names its (scenario, seed) for replay.
+
+  python tools/sim_run.py --selftest
+      Fast determinism + recovery proof (wired into tools/run_suite.sh):
+      same seed => identical log digest, different seed => divergent,
+      crash-restart => WAL replay converges. Exit 0 on success.
+
+  python tools/sim_run.py --list
+      Print the scenario catalog.
+
+On any invariant violation the tool prints a REPLAYABLE failure line:
+  SIMNET-FAIL scenario=<s> seed=<n> ... reproduce: python tools/sim_run.py ...
+and exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cometbft_tpu.simnet.scenarios import (SCENARIOS, run_scenario,  # noqa: E402
+                                           sweep)
+
+
+def _summary(r) -> str:
+    """Deterministic one-liner (no wall time — byte-stable across runs)."""
+    return (f"SUMMARY scenario={r.scenario} seed={r.seed} "
+            f"max_height={r.max_height} commits_per_sim_s="
+            f"{r.commits_per_sim_s:.3f} virtual_s={r.virtual_s:.3f} "
+            f"delivered={r.stats['delivered']} dropped={r.stats['dropped']} "
+            f"blocked={r.stats['blocked']} crashes={r.crashes} "
+            f"restarts={r.restarts} evidence={r.evidence_seen} "
+            f"violations={len(r.violations)} log={r.digest}")
+
+
+def _run_single(args) -> int:
+    r = run_scenario(args.scenario, args.seed, quick=args.quick)
+    for line in r.log_lines:
+        print(line)
+    print(_summary(r))
+    print(f"# wall {r.wall_s:.2f}s, {r.stats['events']} events",
+          file=sys.stderr)
+    for err in r.errors:
+        print(f"# node error: {err}", file=sys.stderr)
+    if not r.ok:
+        for v in r.violations:
+            print(f"VIOLATION {v}", file=sys.stderr)
+        print(r.failure_line())
+        return 1
+    return 0
+
+
+def _run_sweep(args) -> int:
+    a, _, b = args.seeds.partition("..")
+    seeds = range(int(a), int(b) + 1) if b else [int(a)]
+    t0 = time.monotonic()
+    failed = 0
+    for r in sweep(seeds, scenario=args.scenario, quick=args.quick):
+        status = "OK" if r.ok else "FAIL"
+        print(f"{status} scenario={r.scenario} seed={r.seed} "
+              f"h={r.max_height} commits_per_sim_s="
+              f"{r.commits_per_sim_s:.2f} wall={r.wall_s:.2f}s "
+              f"log={r.digest[:16]}")
+        if not r.ok:
+            failed += 1
+            print(r.failure_line())
+    n = len(list(seeds))
+    print(f"sweep: {n - failed}/{n} clean in "
+          f"{time.monotonic() - t0:.1f}s wall")
+    return 1 if failed else 0
+
+
+def _selftest() -> int:
+    t0 = time.monotonic()
+    a = run_scenario("baseline", 7, quick=True)
+    b = run_scenario("baseline", 7, quick=True)
+    if a.digest != b.digest:
+        print("SELFTEST FAIL: same seed produced different event logs")
+        print(f"  {a.digest} vs {b.digest}")
+        return 1
+    c = run_scenario("baseline", 8, quick=True)
+    if c.digest == a.digest:
+        print("SELFTEST FAIL: different seeds produced identical logs")
+        return 1
+    d = run_scenario("crash-restart", 3, quick=True)
+    if not d.ok or d.restarts < 1:
+        print("SELFTEST FAIL: crash-restart did not recover "
+              f"(violations={d.violations}, restarts={d.restarts})")
+        print(d.failure_line())
+        return 1
+    for r in (a, c, d):
+        if not r.ok:
+            print(r.failure_line())
+            return 1
+    print(f"SELFTEST OK: determinism + crash recovery "
+          f"({time.monotonic() - t0:.1f}s wall, "
+          f"h={a.max_height}/{c.max_height}/{d.max_height})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seeds", help="A..B inclusive sweep")
+    ap.add_argument("--scenario", default=None,
+                    help="scenario name, or 'all' (sweep round-robin)")
+    ap.add_argument("--quick", action="store_true",
+                    help="reduced target heights (CI smoke)")
+    ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            s = SCENARIOS[name]
+            print(f"{name:20} target_h={s.target_height:2} "
+                  f"deadline={s.deadline_ms}ms  {s.description}")
+        return 0
+    if args.selftest:
+        return _selftest()
+    if args.seeds:
+        args.scenario = args.scenario or "all"
+        return _run_sweep(args)
+    args.scenario = args.scenario or "baseline"
+    return _run_single(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
