@@ -181,12 +181,19 @@ class StateSyncConfig:
 
 @dataclass
 class BlockSyncConfig:
-    """reference config/config.go BlockSyncConfig."""
+    """reference config/config.go BlockSyncConfig, plus the verification
+    pipeline depth (tiles kept in flight through pipeline/scheduler on
+    device-backed nodes; 1 = the synchronous loop)."""
     version: str = "v0"
+    pipeline_depth: int = 4
 
     def validate_basic(self) -> None:
         if self.version != "v0":
             raise ValueError(f"unknown blocksync version {self.version}")
+        if not 1 <= self.pipeline_depth <= 64:
+            raise ValueError(
+                f"pipeline_depth must be in [1, 64], "
+                f"got {self.pipeline_depth}")
 
 
 @dataclass

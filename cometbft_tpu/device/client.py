@@ -18,11 +18,83 @@ from .protocol import (decode_response, encode_request, recv_frame,
 
 ENV_VAR = "COMETBFT_TPU_DEVICE_SERVER"  # host:port
 
+# Per-request deadline = base + per_sig * lanes (env-overridable): a
+# 64-lane consensus commit should fail over to local verification in
+# seconds, while an 8192-lane blocksync tile gets the headroom a cold
+# compile or a busy queue needs. The old fixed 60s punished both.
+ENV_DEADLINE_BASE = "COMETBFT_TPU_DEVICE_DEADLINE_BASE"
+ENV_DEADLINE_PER_SIG = "COMETBFT_TPU_DEVICE_DEADLINE_PER_SIG"
+DEFAULT_DEADLINE_BASE_S = 20.0
+DEFAULT_DEADLINE_PER_SIG_S = 0.005
+
+
+def deadline_for(n_lanes: int) -> float:
+    """Batch-size-scaled per-request deadline for a device round trip."""
+    try:
+        base = float(os.environ.get(ENV_DEADLINE_BASE,
+                                    DEFAULT_DEADLINE_BASE_S))
+        per = float(os.environ.get(ENV_DEADLINE_PER_SIG,
+                                   DEFAULT_DEADLINE_PER_SIG_S))
+    except ValueError:
+        base, per = DEFAULT_DEADLINE_BASE_S, DEFAULT_DEADLINE_PER_SIG_S
+    return base + per * max(0, n_lanes)
+
 
 class DeviceUnprocessable(Exception):
     """The server could not run this batch (oversized message / too
     many lanes) — distinct from per-lane verification failure so the
     caller verifies locally instead of blaming signatures."""
+
+
+class DeviceFuture:
+    """Handle for an in-flight submit(): the non-blocking seam the
+    verification pipeline dispatches through (pipeline/scheduler
+    overlaps tile N's device round trip with tile N+1's host marshal)."""
+
+    def __init__(self, client: "DeviceClient", req_id: int, n_lanes: int):
+        self._client = client
+        self._req_id = req_id
+        self._n = n_lanes
+        self._ev = threading.Event()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def cancel(self) -> None:
+        """Abandon this request: nothing will wait for the answer, so
+        drop the pending entry (the recv routine then discards the late
+        response) and any already-stored result. Callers that drop
+        in-flight dispatches (pipeline drain on a bad block) MUST
+        cancel, or verdict lists accumulate in the shared client's
+        _results for the life of the process."""
+        c = self._client
+        with c._wlock:
+            c._pending.pop(self._req_id, None)
+            c._results.pop(self._req_id, None)
+
+    def result(self, timeout: Optional[float] = None
+               ) -> Tuple[bool, List[bool]]:
+        """(batch_ok, per-lane oks); default timeout scales with the
+        batch size. Raises TimeoutError on deadline, ConnectionError on
+        a dead link, DeviceUnprocessable on a lane-count mismatch."""
+        c = self._client
+        if timeout is None:
+            timeout = deadline_for(self._n)
+        if not self._ev.wait(timeout):
+            with c._wlock:
+                c._pending.pop(self._req_id, None)
+                # the answer may have landed between the wait expiring
+                # and this lock: drop it too, nobody will collect it
+                c._results.pop(self._req_id, None)
+            raise TimeoutError("device server did not answer")
+        with c._wlock:
+            if self._req_id not in c._results:
+                raise ConnectionError(f"device link down: {c._dead}")
+            batch_ok, oks = c._results.pop(self._req_id)
+        if len(oks) != self._n:
+            raise DeviceUnprocessable(
+                f"server answered {len(oks)} lanes for {self._n}")
+        return batch_ok, oks
 
 
 class DeviceClient:
@@ -71,22 +143,19 @@ class DeviceClient:
                     ev.set()
                 self._pending.clear()
 
-    def verify(self, pubs: List[bytes], msgs: List[bytes],
-               sigs: List[bytes], timeout: float = 60.0
-               ) -> Tuple[bool, List[bool]]:
-        """timeout bounds a WEDGED server (kernels are pre-warmed at
-        server start, so a healthy device flush is milliseconds; the
-        margin accommodates CPU-backed test servers) — callers like
-        RemoteBatchVerifier then degrade to local verification rather
-        than stalling the consensus verify path forever."""
+    def submit(self, pubs: List[bytes], msgs: List[bytes],
+               sigs: List[bytes]) -> DeviceFuture:
+        """Non-blocking dispatch: frame the batch onto the wire and
+        return a future the receive thread resolves — the seam the
+        verification pipeline keeps K tiles in flight through."""
         if not pubs:
-            return False, []
+            raise ValueError("empty batch")
         req_id = next(self._ids)
-        ev = threading.Event()
+        fut = DeviceFuture(self, req_id, len(pubs))
         with self._wlock:
             if self._dead is not None:
                 raise ConnectionError(f"device link down: {self._dead}")
-            self._pending[req_id] = ev
+            self._pending[req_id] = fut._ev
             try:
                 send_frame(self._sock, encode_request(req_id, pubs,
                                                       msgs, sigs))
@@ -105,19 +174,20 @@ class DeviceClient:
                 except OSError:
                     pass
                 raise ConnectionError(f"device send failed: {e}") from e
-        if not ev.wait(timeout):
-            with self._wlock:
-                self._pending.pop(req_id, None)
-            raise TimeoutError("device server did not answer")
-        with self._wlock:
-            if req_id not in self._results:
-                raise ConnectionError(
-                    f"device link down: {self._dead}")
-            batch_ok, oks = self._results.pop(req_id)
-        if len(oks) != len(pubs):
-            raise DeviceUnprocessable(
-                f"server answered {len(oks)} lanes for {len(pubs)}")
-        return batch_ok, oks
+        return fut
+
+    def verify(self, pubs: List[bytes], msgs: List[bytes],
+               sigs: List[bytes], timeout: Optional[float] = None
+               ) -> Tuple[bool, List[bool]]:
+        """Blocking submit + wait. The deadline bounds a WEDGED server
+        (kernels are pre-warmed at server start, so a healthy device
+        flush is milliseconds; the margin accommodates CPU-backed test
+        servers) — callers like RemoteBatchVerifier then degrade to
+        local verification rather than stalling the consensus verify
+        path forever. Default: batch-size-scaled `deadline_for`."""
+        if not pubs:
+            return False, []
+        return self.submit(pubs, msgs, sigs).result(timeout)
 
     def close(self) -> None:
         try:
@@ -184,9 +254,25 @@ class RemoteBatchVerifier:
     def verify(self) -> Tuple[bool, List[bool]]:
         if not self._pubs:
             return False, []
-        try:
-            return self._client.verify(self._pubs, self._msgs,
-                                       self._sigs)
-        except (DeviceUnprocessable, ConnectionError, TimeoutError,
-                OSError):
-            return self._local()
+        for attempt in (0, 1):
+            try:
+                return self._client.verify(self._pubs, self._msgs,
+                                           self._sigs)
+            except DeviceUnprocessable:
+                break  # a retry cannot shrink the batch: go local now
+            except TimeoutError:
+                # the server is wedged but the socket is up: a second
+                # attempt would hit the same wedge and DOUBLE the
+                # consensus-path stall this deadline exists to bound
+                break
+            except (ConnectionError, OSError):
+                if attempt:
+                    break
+                # one retry before abandoning the device: a dead link
+                # may reconnect through shared_client() (the env-based
+                # singleton drops dead links on each call, and an
+                # unreachable server fails the connect fast)
+                fresh = shared_client()
+                if fresh is not None:
+                    self._client = fresh
+        return self._local()

@@ -13,6 +13,13 @@ state confirms that speculation ((b) is `validate_block`'s
 validators_hash check; on mismatch the commit is re-verified synchronously
 against the true set — speculation can only waste work, never admit a bad
 block).
+
+The tile stages — fetch (`_fetch_range`), marshal (`marshal_commit`),
+lane verify (`verify_lanes`), verdict settle (`settle_tile`), and
+per-height apply (`_apply_one`) — are standalone so the asynchronous
+pipeline (`pipeline/scheduler.py`) composes the SAME stages with K tiles
+in flight; `pipeline_depth=1` (the default here) is the synchronous
+degenerate case and this module's `_sync_tile` loop.
 """
 
 from __future__ import annotations
@@ -51,13 +58,101 @@ class TileEntry:
     commit_ok: Optional[bool] = None
 
 
+def marshal_commit(chain_id: str, e: TileEntry, pubs: List[bytes],
+                   msgs: List[bytes], sigs: List[bytes], cache=None):
+    """Marshal one commit's non-absent signatures into the lane lists;
+    returns (entry, rows, needed) with rows=None on structural
+    rejection. Each row is (lane, power, counted); lane=-1 marks a
+    verified-signature-cache hit that occupies no device lane.
+
+    Standalone (not a verifier method) because this IS the pipeline's
+    host marshal stage: the scheduler runs it for tile N+1 while the
+    device verifies tile N's lanes."""
+    commit = e.commit
+    vals = e.valset
+    if len(vals) != len(commit.signatures):
+        return e, None, 0
+    if commit.height != e.height or commit.block_id != e.block_id:
+        return e, None, 0
+    needed = vals.total_voting_power() * 2 // 3
+    rows = []
+    for idx, cs in enumerate(commit.signatures):
+        if cs.absent_():
+            continue
+        try:
+            cs.validate_basic()
+        except ValueError:
+            return e, None, 0
+        val = vals.get_by_index(idx)
+        msg = commit.vote_sign_bytes(chain_id, idx)
+        pkb = val.pub_key.bytes_()
+        if cache is not None and cache.seen(pkb, msg, cs.signature,
+                                            path="blocksync"):
+            rows.append((-1, val.voting_power, cs.for_block()))
+            continue
+        row = len(pubs)
+        pubs.append(pkb)
+        msgs.append(msg)
+        sigs.append(cs.signature)
+        rows.append((row, val.voting_power, cs.for_block()))
+    return e, rows, needed
+
+
+def verify_lanes(pubs: Sequence[bytes], msgs: Sequence[bytes],
+                 sigs: Sequence[bytes], batch_size: int) -> np.ndarray:
+    """Per-lane verdicts for flat (pub, msg, sig) triples — the device
+    path selection shared by the synchronous tile verifier and the
+    pipeline's in-process dispatch backend."""
+    from ..types.validation import BATCH_VERIFY_THRESHOLD
+    if not pubs:
+        return np.zeros((0,), dtype=bool)
+    if batch_size <= 0 or len(pubs) < BATCH_VERIFY_THRESHOLD:
+        # batch_size<=0 = no device: CPU-backend nodes must never
+        # jit the RLC kernel mid-sync (a multi-minute XLA:CPU
+        # compile per bucket, and batches >=256 crash the compiler
+        # outright — docs/PERF.md). Small tiles take this path too:
+        # the native single-sig verify beats a device dispatch +
+        # cold compile for boot catch-up over a few heights.
+        from ..crypto.keys import Ed25519PubKey
+        return np.array([
+            len(p) == 32 and Ed25519PubKey(p).verify_signature(m, s)
+            for p, m, s in zip(pubs, msgs, sigs)], dtype=bool)
+    from ..parallel.verify import mesh_available
+    if mesh_available():
+        # >1 chip: the sharded RLC path — lanes spread over the
+        # mesh, one all_gather of window partials per tile
+        # (parallel/verify.verify_batch_mesh)
+        from ..parallel.verify import verify_batch_mesh
+        return verify_batch_mesh(pubs, msgs, sigs, batch_size=batch_size)
+    from ..ops.ed25519 import verify_batch
+    return verify_batch(pubs, msgs, sigs, batch_size=batch_size)
+
+
+def settle_tile(metas, out, pubs, msgs, sigs, cache=None) -> None:
+    """Map per-lane verdicts back to per-commit results with FULL
+    verify_commit semantics (every included signature valid AND for-block
+    power > 2/3); newly verified-true lanes feed the cache."""
+    for e, rows, needed in metas:
+        if rows is None:  # structural failure already decided
+            e.commit_ok = False
+            continue
+        all_valid = all(r < 0 or out[r] for r, _p, _c in rows)
+        tallied = sum(p for r, p, counted in rows if counted)
+        e.commit_ok = all_valid and tallied > needed
+        if cache is not None:
+            for r, _p, _c in rows:
+                if r >= 0 and out[r]:
+                    cache.add(pubs[r], msgs[r], sigs[r])
+
+
 class TiledCommitVerifier:
     """Flatten the non-absent signatures of many commits into one device
     batch; per-lane verdicts map back to per-commit results."""
 
-    def __init__(self, chain_id: str, batch_size: int = 4096):
+    def __init__(self, chain_id: str, batch_size: int = 4096, cache=None):
         self.chain_id = chain_id
         self.batch_size = batch_size
+        self.cache = cache  # pipeline.cache.SigCache or None
 
     def verify_tile(self, entries: Sequence[TileEntry]) -> None:
         """Sets entry.commit_ok per entry with FULL verify_commit
@@ -69,71 +164,16 @@ class TiledCommitVerifier:
         pubs: List[bytes] = []
         msgs: List[bytes] = []
         sigs: List[bytes] = []
-        metas = []  # (entry, [(sig_row, power, counted)], needed)
-        for e in entries:
-            metas.append(self._add_commit(e, pubs, msgs, sigs))
-
-        from ..types.validation import BATCH_VERIFY_THRESHOLD
-        if not pubs:
-            out = np.zeros((0,), dtype=bool)
-        elif self.batch_size <= 0 or len(pubs) < BATCH_VERIFY_THRESHOLD:
-            # batch_size<=0 = no device: CPU-backend nodes must never
-            # jit the RLC kernel mid-sync (a multi-minute XLA:CPU
-            # compile per bucket, and batches >=256 crash the compiler
-            # outright — docs/PERF.md). Small tiles take this path too:
-            # the native single-sig verify beats a device dispatch +
-            # cold compile for boot catch-up over a few heights.
-            from ..crypto.keys import Ed25519PubKey
-            out = np.array([
-                len(p) == 32 and Ed25519PubKey(p).verify_signature(m, s)
-                for p, m, s in zip(pubs, msgs, sigs)], dtype=bool)
-        else:
-            from ..parallel.verify import mesh_available
-            if mesh_available():
-                # >1 chip: the sharded RLC path — lanes spread over the
-                # mesh, one all_gather of window partials per tile
-                # (parallel/verify.verify_batch_mesh)
-                from ..parallel.verify import verify_batch_mesh
-                out = verify_batch_mesh(pubs, msgs, sigs,
-                                        batch_size=self.batch_size)
-            else:
-                from ..ops.ed25519 import verify_batch
-                out = verify_batch(pubs, msgs, sigs,
-                                   batch_size=self.batch_size)
-
-        for e, rows, needed in metas:
-            if rows is None:  # structural failure already decided
-                e.commit_ok = False
-                continue
-            all_valid = all(out[r] for r, _p, _c in rows)
-            tallied = sum(p for r, p, counted in rows if counted)
-            e.commit_ok = all_valid and tallied > needed
+        metas = [marshal_commit(self.chain_id, e, pubs, msgs, sigs,
+                                self.cache) for e in entries]
+        out = verify_lanes(pubs, msgs, sigs, self.batch_size)
+        settle_tile(metas, out, pubs, msgs, sigs, self.cache)
 
     def _add_commit(self, e: TileEntry, pubs, msgs, sigs):
-        """Marshal one commit's non-absent signatures; returns
-        (entry, rows, needed) with rows=None on structural rejection."""
-        commit = e.commit
-        vals = e.valset
-        if len(vals) != len(commit.signatures):
-            return e, None, 0
-        if commit.height != e.height or commit.block_id != e.block_id:
-            return e, None, 0
-        needed = vals.total_voting_power() * 2 // 3
-        rows = []
-        for idx, cs in enumerate(commit.signatures):
-            if cs.absent_():
-                continue
-            try:
-                cs.validate_basic()
-            except ValueError:
-                return e, None, 0
-            val = vals.get_by_index(idx)
-            row = len(pubs)
-            pubs.append(val.pub_key.bytes_())
-            msgs.append(commit.vote_sign_bytes(self.chain_id, idx))
-            sigs.append(cs.signature)
-            rows.append((row, val.voting_power, cs.for_block()))
-        return e, rows, needed
+        """Back-compat shim; the standalone marshal stage is
+        `marshal_commit`."""
+        return marshal_commit(self.chain_id, e, pubs, msgs, sigs,
+                              self.cache)
 
 
 @dataclass
@@ -148,29 +188,56 @@ class SyncStalled(Exception):
     """The peer source cannot currently provide the next needed block."""
 
 
+class TileApplyError(Exception):
+    """A block failed commit/header verification during apply; carries
+    the offending height so the caller can ban and decide whether the
+    partial progress stands."""
+
+    def __init__(self, height: int, msg: str):
+        super().__init__(msg)
+        self.height = height
+
+
 class BlocksyncReactor:
     """Sequential-apply, tile-verified catch-up loop
-    (reference internal/blocksync/reactor.go poolRoutine)."""
+    (reference internal/blocksync/reactor.go poolRoutine).
+
+    With `pipeline_depth` > 1 the tile loop runs through
+    `pipeline/scheduler.PipelinedBlocksync` — same stages, K tiles in
+    flight, apply still strictly sequential. depth=1 keeps this module's
+    synchronous loop (the degenerate case)."""
 
     def __init__(self, executor: BlockExecutor, store: BlockStore,
                  source: PeerSource, chain_id: str, tile_size: int = 32,
-                 batch_size: int = 4096, max_retries: int = 3):
+                 batch_size: int = 4096, max_retries: int = 3,
+                 pipeline_depth: int = 1, backend=None, watchdog=None,
+                 cache=None, metrics=None):
         self.executor = executor
         self.store = store
         self.source = source
-        self.verifier = TiledCommitVerifier(chain_id, batch_size)
+        self.verifier = TiledCommitVerifier(chain_id, batch_size,
+                                            cache=cache)
         self.tile_size = tile_size
         self.max_retries = max_retries
+        self.pipeline_depth = pipeline_depth
+        self.backend = backend      # pipeline verify backend (optional)
+        self.watchdog = watchdog    # pipeline.watchdog.DeviceWatchdog
+        self.cache = cache          # pipeline.cache.SigCache
+        self.metrics = metrics      # libs/metrics_gen.PipelineMetrics
         self.stats = SyncStats()
-        # (height, sha256(commit.encode())) of the last tile-verified seal,
+        # [height, commit, digest|None] of the last tile-verified seal,
         # keyed by the height of the block that CARRIES it as last_commit.
         # Applying a block skips last-commit signature re-verification only
         # when its last_commit bytes are the very bytes the tile verifier
         # checked — enforced, not assumed: blocks at tile boundaries are
-        # re-fetched (possibly from another peer), so a digest mismatch
-        # falls back to the reference behavior of a full VerifyCommit
-        # (reference state/validation.go:94).
-        self._verified_seal: Optional[Tuple[int, bytes]] = None
+        # re-fetched (possibly from another peer), so a mismatch falls
+        # back to the reference behavior of a full VerifyCommit
+        # (reference state/validation.go:94). "Same bytes" is decided by
+        # object identity first (the common case: the seal we verified IS
+        # the next block's last_commit from the same fetch) and by a
+        # lazily computed sha256 over the encoding otherwise — commit
+        # re-encoding per height dominated the sequential apply stage.
+        self._verified_seal: Optional[list] = None
 
     def sync(self, state: State, target_height: Optional[int] = None
              ) -> State:
@@ -178,25 +245,48 @@ class BlocksyncReactor:
         retried against (presumably re-routed) fetches, bounded by
         max_retries (reference reactor.go:498-513 bans + requeues)."""
         target = target_height or self.source.max_height()
+        pipe = None
+        step = self._sync_tile
+        if self.pipeline_depth > 1:
+            from ..pipeline.scheduler import PipelinedBlocksync
+            pipe = PipelinedBlocksync(
+                self, depth=self.pipeline_depth, backend=self.backend,
+                watchdog=self.watchdog, metrics=self.metrics)
+            step = pipe.run
         retries = 0
-        while state.last_block_height < target:
-            try:
-                state = self._sync_tile(state, target)
-                retries = 0
-            except (BlockValidationError, SyncStalled):
-                retries += 1
-                if retries > self.max_retries:
-                    raise
+        try:
+            while state.last_block_height < target:
+                try:
+                    state = step(state, target)
+                    retries = 0
+                except (BlockValidationError, SyncStalled):
+                    retries += 1
+                    if retries > self.max_retries:
+                        raise
+        finally:
+            if pipe is not None:
+                pipe.close()
         return state
 
-    def _sync_tile(self, state: State, target: int) -> State:
-        start = state.last_block_height + 1
-        end = min(start + self.tile_size - 1, target)
+    # --- stages shared with pipeline/scheduler ----------------------------
 
-        # fetch blocks start..end plus end+1 (its LastCommit seals block
-        # end; a peer at the tip serves its seen-commit as a synthetic
-        # successor). Part sets / block ids are computed ONCE here — the
-        # advertised peer block_id is never trusted.
+    def _stall_msg(self, height: int) -> str:
+        msg = f"source cannot provide block {height}"
+        pend = getattr(self.source, "pending_fetches", None)
+        if pend is not None:
+            msg += (f" (stalled at height {height}, "
+                    f"{pend()} fetches pending)")
+        return msg
+
+    def _fetch_range(self, start: int, target: int
+                     ) -> Tuple[Dict[int, Tuple[Block, object, BlockID]],
+                                int]:
+        """Fetch blocks start..end plus end+1 (its LastCommit seals block
+        end; a peer at the tip serves its seen-commit as a synthetic
+        successor). Part sets / block ids are computed ONCE here — the
+        advertised peer block_id is never trusted. Raises SyncStalled
+        when not even (start, start+1) can be served."""
+        end = min(start + self.tile_size - 1, target)
         fetched: Dict[int, Tuple[Block, object, BlockID]] = {}
         for h in range(start, end + 2):
             got = self.source.fetch(h)
@@ -211,8 +301,66 @@ class BlocksyncReactor:
             else:
                 fetched[h] = (block, None, BlockID())
         if end < start:
-            raise SyncStalled(
-                f"source cannot provide blocks {start}..{start + 1}")
+            raise SyncStalled(self._stall_msg(start))
+        return fetched, end
+
+    def _apply_one(self, state: State, h: int, block: Block, parts,
+                   block_id: BlockID, seal_commit,
+                   e: Optional[TileEntry]) -> State:
+        """Verify + apply ONE block at height h; raises TileApplyError
+        on a bad commit/block (caller bans and decides about partial
+        progress). Shared verbatim by the synchronous tile loop and the
+        pipeline's sequential apply stage."""
+        used_ok = None
+        if e is not None and e.valset.hash() == state.validators.hash():
+            used_ok = e.commit_ok
+        if used_ok is None:
+            # speculation miss (valset changed mid-tile or header
+            # announced a change): verify synchronously, full
+            # semantics, against the true set
+            self.stats.respeculations += 1
+            try:
+                validation.verify_commit(
+                    self.verifier.chain_id, state.validators, block_id,
+                    h, seal_commit)
+                used_ok = True
+            except validation.CommitVerificationError:
+                used_ok = False
+        if not used_ok:
+            raise TileApplyError(
+                h, f"invalid commit for height {h} from peer")
+
+        seal = self._verified_seal
+        seal_checked = False
+        if seal is not None and seal[0] == h:
+            if seal[1] is block.last_commit:
+                seal_checked = True  # identical object => identical bytes
+            else:
+                if seal[2] is None:
+                    seal[2] = hashlib.sha256(seal[1].encode()).digest()
+                lc_digest = hashlib.sha256(
+                    block.last_commit.encode()).digest()
+                seal_checked = seal[2] == lc_digest
+        try:
+            self.executor.validate_block(
+                state, block, check_commit=not seal_checked)
+        except (BlockValidationError,
+                validation.CommitVerificationError) as exc:
+            raise TileApplyError(
+                h, f"invalid block at height {h}: {exc}") from exc
+
+        self.store.save_block(block, parts, seal_commit)
+        state, _resp = self.executor.apply_block(
+            state, block_id, block, verified=True)
+        self._verified_seal = [h + 1, seal_commit, None]
+        self.stats.blocks_applied += 1
+        return state
+
+    # --- the synchronous (depth=1) tile loop ------------------------------
+
+    def _sync_tile(self, state: State, target: int) -> State:
+        start = state.last_block_height + 1
+        fetched, end = self._fetch_range(start, target)
 
         # speculate: per height, the valset is the tile-start set until a
         # header announces a different validators_hash
@@ -240,49 +388,14 @@ class BlocksyncReactor:
         while h <= end:
             block, parts, block_id = fetched[h]
             seal_commit = fetched[h + 1][0].last_commit
-
-            e = by_height.get(h)
-            used_ok = None
-            if e is not None and e.valset.hash() == state.validators.hash():
-                used_ok = e.commit_ok
-            if used_ok is None:
-                # speculation miss (valset changed mid-tile or header
-                # announced a change): verify synchronously, full
-                # semantics, against the true set
-                self.stats.respeculations += 1
-                try:
-                    validation.verify_commit(
-                        self.verifier.chain_id, state.validators, block_id,
-                        h, seal_commit)
-                    used_ok = True
-                except validation.CommitVerificationError:
-                    used_ok = False
-            if not used_ok:
+            try:
+                state = self._apply_one(state, h, block, parts, block_id,
+                                        seal_commit, by_height.get(h))
+            except TileApplyError as f:
                 self.source.ban(h)
                 if applied_any:
                     return state  # retry the remainder in a fresh tile
-                raise BlockValidationError(
-                    f"invalid commit for height {h} from peer")
-
-            lc_digest = hashlib.sha256(block.last_commit.encode()).digest()
-            seal_checked = self._verified_seal == (h, lc_digest)
-            try:
-                self.executor.validate_block(
-                    state, block, check_commit=not seal_checked)
-            except (BlockValidationError,
-                    validation.CommitVerificationError) as exc:
-                self.source.ban(h)
-                if applied_any:
-                    return state
-                raise BlockValidationError(
-                    f"invalid block at height {h}: {exc}") from exc
-
-            self.store.save_block(block, parts, seal_commit)
-            state, _resp = self.executor.apply_block(
-                state, block_id, block, verified=True)
-            self._verified_seal = (
-                h + 1, hashlib.sha256(seal_commit.encode()).digest())
-            self.stats.blocks_applied += 1
+                raise BlockValidationError(str(f)) from f
             applied_any = True
             h += 1
         return state

@@ -24,13 +24,15 @@ class BlockPool:
     def __init__(self, fetch: Callable[[int], Optional[Tuple[Block, BlockID]]],
                  max_height: Callable[[], int],
                  start_height: int, lookahead: int = 64,
-                 n_workers: int = 8):
+                 n_workers: int = 8, pop_timeout: float = 30.0):
         self._fetch = fetch
         self._max_height = max_height
         self._lookahead = lookahead
+        self._pop_timeout = pop_timeout
         self._next_wanted = start_height
         self._next_to_schedule = start_height
         self._buffer: Dict[int, Optional[Tuple[Block, BlockID]]] = {}
+        self._pending = 0  # scheduled fetches not yet landed (under lock)
         self._lock = threading.Lock()
         self._available = threading.Condition(self._lock)
         self._work: "queue.Queue[int]" = queue.Queue()
@@ -41,16 +43,18 @@ class BlockPool:
             for i in range(n_workers)]
         for w in self._workers:
             w.start()
-        self._schedule()
+        with self._lock:
+            self._schedule()
 
     def _schedule(self) -> None:
         """Keep up to `lookahead` heights in flight (pool.go:616
-        makeRequestersRoutine)."""
+        makeRequestersRoutine). Caller holds the lock."""
         # +1: the tile engine fetches max_height+1 for the synthetic
         # successor that seals the tip (engine/blocksync._sync_tile)
         top = min(self._next_wanted + self._lookahead - 1,
                   self._max_height() + 1)
         while self._next_to_schedule <= top:
+            self._pending += 1
             self._work.put(self._next_to_schedule)
             self._next_to_schedule += 1
 
@@ -60,12 +64,24 @@ class BlockPool:
                 h = self._work.get(timeout=0.2)
             except queue.Empty:
                 continue
-            got = self._fetch(h)
+            try:
+                got = self._fetch(h)
+            except Exception:  # noqa: BLE001 — a raising fetch lands as
+                # a miss (peer error ≙ no block) instead of killing the
+                # worker and leaving _pending overcounted forever
+                got = None
             with self._available:
                 self._buffer[h] = got
+                self._pending -= 1
                 self._available.notify_all()
 
-    def pop(self, height: int, timeout: float = 30.0
+    def pending_count(self) -> int:
+        """Scheduled fetches that have not landed in the buffer yet —
+        reported in SyncStalled diagnostics."""
+        with self._lock:
+            return self._pending
+
+    def pop(self, height: int, timeout: Optional[float] = None
             ) -> Optional[Tuple[Block, BlockID]]:
         """Blocking ordered read; also advances the scheduling window.
 
@@ -74,6 +90,8 @@ class BlockPool:
         next tile's seal provider, once as a member — so a destructive
         pop would hang the second read (reference pool.go PeekTwoBlocks
         keeps blocks until PopRequest for the same reason)."""
+        if timeout is None:
+            timeout = self._pop_timeout
         with self._available:
             if height > self._next_wanted:
                 self._next_wanted = height
@@ -93,6 +111,7 @@ class BlockPool:
         requester after banning the peer, pool.go:776)."""
         with self._available:
             self._buffer.pop(height, None)
+            self._pending += 1
         self._work.put(height)
 
     def stop(self) -> None:
@@ -104,16 +123,21 @@ class PooledSource:
     buffer instead of the network directly."""
 
     def __init__(self, inner, start_height: int, lookahead: int = 64,
-                 n_workers: int = 8):
+                 n_workers: int = 8, pop_timeout: float = 30.0):
         self._inner = inner
         self._pool = BlockPool(inner.fetch, inner.max_height,
-                               start_height, lookahead, n_workers)
+                               start_height, lookahead, n_workers,
+                               pop_timeout=pop_timeout)
 
     def max_height(self) -> int:
         return self._inner.max_height()
 
     def fetch(self, height: int):
         return self._pool.pop(height)
+
+    def pending_fetches(self) -> int:
+        """Surfaced by BlocksyncReactor in SyncStalled messages."""
+        return self._pool.pending_count()
 
     def ban(self, height: int) -> None:
         self._inner.ban(height)

@@ -29,6 +29,27 @@ METRICS_SPEC = {
         ("counter", "peer_dial_failures", "p2p_peer_dial_failures",
          "Failed outbound dial attempts", ()),
     ],
+    # pipeline/ — the asynchronous multi-tile verification data plane
+    # (pipeline/scheduler.py, watchdog.py, cache.py); cache hit rate =
+    # hits / (hits + misses) per intake path
+    "PipelineMetrics": [
+        ("gauge", "tiles_in_flight", "pipeline_tiles_in_flight",
+         "Tiles dispatched to the verify backend but not yet applied",
+         ()),
+        ("gauge", "stage_occupancy", "pipeline_stage_occupancy",
+         "Tiles resident per pipeline stage", ("stage",)),
+        ("counter", "tiles_dispatched", "pipeline_tiles_dispatched",
+         "Tiles submitted to the verify backend", ()),
+        ("counter", "wedge_fallbacks", "pipeline_wedge_fallbacks",
+         "Tiles drained to the CPU fallback by the device-wedge "
+         "watchdog", ()),
+        ("counter", "cache_hits", "pipeline_sigcache_hits",
+         "Verified-signature cache hits, by intake path", ("path",)),
+        ("counter", "cache_misses", "pipeline_sigcache_misses",
+         "Verified-signature cache misses, by intake path", ("path",)),
+        ("counter", "cache_evictions", "pipeline_sigcache_evictions",
+         "Verified-signature cache LRU evictions", ()),
+    ],
     # reference mempool/metrics.go
     "MempoolMetrics": [
         ("gauge", "size", "mempool_size",

@@ -197,9 +197,19 @@ class Node:
         # libs/metrics_defs.py — the reference's scripts/metricsgen
         # role): mempool occupancy now, p2p wiring after the switch
         # exists below
-        from ..libs.metrics_gen import MempoolMetrics, P2PMetrics
+        from ..libs.metrics_gen import (MempoolMetrics, P2PMetrics,
+                                        PipelineMetrics)
         self._p2p_metrics_cls = P2PMetrics
         self.mempool.metrics = MempoolMetrics(self.metrics_registry)
+        self.pipeline_metrics = PipelineMetrics(self.metrics_registry)
+        # the process-wide verified-signature cache (vote intake, light
+        # client, blocksync) reports hit/miss/eviction through the same
+        # struct. First node wins: with several nodes in one process
+        # (in-process tests) re-pointing the singleton would misfile
+        # every earlier node's counts under the newest registry.
+        from ..pipeline.cache import shared_cache
+        if shared_cache().metrics is None:
+            shared_cache().metrics = self.pipeline_metrics
         cc = config.consensus
         self.consensus = ConsensusState(
             ConsensusConfig(
@@ -443,6 +453,7 @@ class Node:
     def _sync_then_consensus(self) -> None:
         from ..engine.blocksync import (BlocksyncReactor, SyncStalled)
         from ..engine.pool import PooledSource
+        from ..pipeline.cache import shared_cache
         from ..state.execution import BlockValidationError
         src = NetSource(self.blocksync_reactor, self.switch)
         state = self.consensus.state
@@ -464,10 +475,34 @@ class Node:
                 break
             pooled = PooledSource(src, state.last_block_height + 1,
                                   lookahead=32, n_workers=4)
+            # device-backed nodes run the asynchronous verification
+            # pipeline (device verify of tile N overlaps fetch/marshal/
+            # apply of neighbors) under the wedge watchdog; CPU nodes
+            # keep the synchronous loop — native verify has no device
+            # latency to hide and threads would only add overhead
+            batch = self._device_batch_size()
+            depth = (self.config.blocksync.pipeline_depth
+                     if batch > 0 else 1)
+            watchdog = backend = None
+            if depth > 1:
+                from ..pipeline.watchdog import DeviceWatchdog
+                watchdog = DeviceWatchdog(
+                    metrics=self.pipeline_metrics)
+                # with the host's TPU-owner server configured, dispatch
+                # through the non-blocking DeviceClient.submit() seam;
+                # otherwise the scheduler's in-process dispatch thread
+                # drives the local JAX kernels
+                from ..device.client import shared_client
+                client = shared_client()
+                if client is not None:
+                    from ..pipeline.scheduler import DeviceClientBackend
+                    backend = DeviceClientBackend(client)
             engine = BlocksyncReactor(
                 self.executor, self.block_store, pooled,
                 self.genesis.chain_id, tile_size=16,
-                batch_size=self._device_batch_size())
+                batch_size=batch, pipeline_depth=depth,
+                backend=backend, watchdog=watchdog,
+                cache=shared_cache(), metrics=self.pipeline_metrics)
             try:
                 state = engine.sync(state, target)
             except (BlockValidationError, SyncStalled):

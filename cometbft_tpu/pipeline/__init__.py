@@ -1,0 +1,22 @@
+"""pipeline/ — asynchronous multi-tile verification data plane.
+
+The blocksync catch-up hot loop (engine/blocksync) is host-bound when
+run synchronously: the host idles while the device verifies a tile and
+the device idles while the host fetches/marshals/applies the next one.
+This package keeps K tiles in flight instead:
+
+- `scheduler.py` — bounded-queue staged scheduler (fetch → marshal →
+  async device dispatch → sequential apply) plus the verify backends
+  (in-process dispatch thread, device-server futures, bench/test stubs);
+- `watchdog.py`  — per-dispatch deadlines with sticky device-wedge
+  detection draining in-flight tiles to a CPU fallback;
+- `cache.py`     — bounded verified-signature cache keyed by
+  (pubkey, sign_bytes, sig), consulted by blocksync tiles, consensus
+  vote intake, and light-client commit verification.
+
+Only `cache` is imported eagerly (it is dependency-free and consulted
+from types/); import `scheduler`/`watchdog` explicitly — they pull in
+the engine layer.
+"""
+
+from .cache import SigCache, shared_cache  # noqa: F401

@@ -1,0 +1,429 @@
+"""Bounded-queue staged scheduler: K verification tiles in flight.
+
+The synchronous blocksync loop (engine/blocksync._sync_tile) serializes
+fetch → marshal → verify → apply, so the host idles while the device
+verifies and the device idles while the host works. Here the stages
+pipeline — the standard answer for verification engines (the FPGA ECDSA
+engine of arXiv:2112.02229 overlaps decode/marshal with curve compute):
+
+    fetch    — engine/pool.py lookahead keeps the wire busy already;
+               the scheduler pulls whole tile ranges ahead of apply
+    marshal  — engine/blocksync.marshal_commit (the lifted standalone
+               form of TiledCommitVerifier._add_commit), run on the
+               host for tile N+1 while tile N verifies
+    dispatch — non-blocking submit to a verify backend: the in-process
+               dispatch thread (LocalAsyncBackend — JAX device work for
+               tile N overlaps host marshal of tile N+1), the device
+               server's DeviceClient.submit() future seam, or a stub
+    apply    — strictly SEQUENTIAL, in height order, with the same
+               `_verified_seal` digest check and respeculation rules as
+               the synchronous loop
+
+Safety is unchanged from the synchronous path because apply is the only
+stage that touches state, and it runs the identical per-height checks
+(engine/blocksync._apply_one): speculative marshal across a validator-set
+change re-verifies on hash mismatch exactly as the current tile loop
+does. `depth=1` IS the synchronous path, one tile at a time.
+
+Wedge handling: every dispatch is bounded by the DeviceWatchdog; a
+deadline miss drains this and all in-flight tiles to a sticky CPU
+fallback (native per-signature verify) so a wedged TPU tunnel degrades
+catch-up speed, never liveness.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..engine.blocksync import (BlocksyncReactor, SyncStalled,
+                                TileApplyError, TileEntry, marshal_commit,
+                                settle_tile, verify_lanes)
+from ..state.execution import BlockValidationError
+from ..state.state import State
+
+
+# --- futures + verify backends ------------------------------------------------
+
+class VerifyFuture:
+    """Minimal future for verify dispatches: result(timeout) returns the
+    per-lane verdict sequence or raises (TimeoutError on deadline,
+    whatever the backend set otherwise)."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._out = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, out) -> None:
+        self._out = out
+        self._ev.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("verify dispatch still pending")
+        if self._exc is not None:
+            raise self._exc
+        return self._out
+
+
+class LocalAsyncBackend:
+    """In-process async dispatch: one daemon thread runs the verify
+    function (ops/ed25519 via engine/blocksync.verify_lanes) so
+    submit() returns immediately — JAX device dispatch of tile N
+    overlaps host marshal of tile N+1. A verify crash lands in the
+    future as an exception; the watchdog turns it into a CPU fallback."""
+
+    def __init__(self, verify_fn, name: str = "pipeline-verify"):
+        self._verify = verify_fn
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def submit(self, pubs, msgs, sigs) -> VerifyFuture:
+        fut = VerifyFuture()
+        self._q.put((fut, pubs, msgs, sigs))
+        return fut
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                fut, pubs, msgs, sigs = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                fut.set_result(self._verify(pubs, msgs, sigs))
+            except BaseException as e:  # noqa: BLE001 — surface via future
+                fut.set_exception(e)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+class DeviceClientBackend:
+    """Dispatch to the host's TPU-owner device server through the
+    non-blocking DeviceClient.submit() seam; result() adapts the
+    (batch_ok, oks) wire answer to a plain verdict sequence."""
+
+    class _Adapter:
+        def __init__(self, fut):
+            self._fut = fut
+
+        def done(self) -> bool:
+            return self._fut.done()
+
+        def cancel(self) -> None:
+            self._fut.cancel()
+
+        def result(self, timeout: Optional[float] = None):
+            _batch_ok, oks = self._fut.result(timeout)
+            return oks
+
+    def __init__(self, client):
+        self._client = client
+
+    def submit(self, pubs, msgs, sigs):
+        return self._Adapter(self._client.submit(pubs, msgs, sigs))
+
+    def close(self) -> None:
+        pass  # the client is shared process-wide; never closed here
+
+
+class FixedLatencyBackend:
+    """Bench/test stub of an RTT-bound device: every dispatch answers a
+    fixed latency after submit, independent of other in-flight
+    dispatches (the tunnel's cost is dominated by round-trip + queueing,
+    not lane occupancy). verify_fn=None answers all-true (valid-chain
+    benchmarks); otherwise verdicts are computed in the timer thread."""
+
+    def __init__(self, latency_s: float, verify_fn=None):
+        self.latency_s = latency_s
+        self._verify = verify_fn
+        self.dispatches = 0
+
+    def submit(self, pubs, msgs, sigs) -> VerifyFuture:
+        self.dispatches += 1
+        fut = VerifyFuture()
+
+        def fire():
+            try:
+                out = (self._verify(pubs, msgs, sigs)
+                       if self._verify is not None
+                       else [True] * len(pubs))
+                fut.set_result(out)
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+        t = threading.Timer(self.latency_s, fire)
+        t.daemon = True
+        t.start()
+        return fut
+
+    def close(self) -> None:
+        pass
+
+
+class HangingBackend:
+    """The wedge fixture: dispatches never answer (until release())."""
+
+    def __init__(self):
+        self._pending: List[Tuple[VerifyFuture, int]] = []
+        self.dispatches = 0
+
+    def submit(self, pubs, msgs, sigs) -> VerifyFuture:
+        self.dispatches += 1
+        fut = VerifyFuture()
+        self._pending.append((fut, len(pubs)))
+        return fut
+
+    def release(self) -> None:
+        for fut, n in self._pending:
+            if not fut.done():
+                fut.set_result([True] * n)
+
+    def close(self) -> None:
+        self.release()  # unblock anything still waiting
+
+
+# --- the scheduler ------------------------------------------------------------
+
+@dataclass
+class _Tile:
+    start: int
+    end: int
+    fetched: Dict[int, tuple]
+    entries: List[TileEntry]
+    metas: list
+    pubs: List[bytes]
+    msgs: List[bytes]
+    sigs: List[bytes]
+    future: object = None            # None => out already final
+    out: Optional[np.ndarray] = None
+    valset_break: bool = False       # a header announced a new valset
+
+    @property
+    def n_lanes(self) -> int:
+        return len(self.pubs)
+
+
+class PipelinedBlocksync:
+    """Runs a BlocksyncReactor's catch-up with `depth` tiles in flight.
+
+    Constructed by BlocksyncReactor.sync() when pipeline_depth > 1; the
+    reactor owns source/executor/store/stats/_verified_seal so the two
+    paths share every stage implementation and all bookkeeping."""
+
+    def __init__(self, reactor: BlocksyncReactor, depth: int = 4,
+                 backend=None, watchdog=None, metrics=None):
+        self.r = reactor
+        self.depth = max(1, depth)
+        self._own_backend = backend is None
+        self.backend = backend or LocalAsyncBackend(
+            lambda p, m, s: verify_lanes(
+                p, m, s, reactor.verifier.batch_size))
+        self.watchdog = watchdog
+        self.metrics = metrics
+
+    def close(self) -> None:
+        if self._own_backend:
+            self.backend.close()
+
+    # --- stages -----------------------------------------------------------
+
+    def _build_tile(self, start: int, target: int, spec_vals) -> _Tile:
+        """fetch + marshal + dispatch for one tile (raises SyncStalled
+        when the source cannot serve the range)."""
+        self._occupy("fetch", 1)
+        try:
+            fetched, end = self.r._fetch_range(start, target)
+        finally:
+            self._occupy("fetch", 0)
+
+        self._occupy("marshal", 1)
+        try:
+            spec_hash = spec_vals.hash()
+            entries: List[TileEntry] = []
+            valset_break = False
+            for h in range(start, end + 1):
+                block, _parts, bid = fetched[h]
+                if block.header.validators_hash != spec_hash:
+                    # valset changes: heights from here respeculate at
+                    # apply against the true set, and the scheduler
+                    # stops filling until the pipeline drains
+                    valset_break = True
+                    break
+                entries.append(TileEntry(
+                    height=h, block=block, block_id=bid, valset=spec_vals,
+                    commit=fetched[h + 1][0].last_commit))
+            pubs: List[bytes] = []
+            msgs: List[bytes] = []
+            sigs: List[bytes] = []
+            metas = [marshal_commit(self.r.verifier.chain_id, e, pubs,
+                                    msgs, sigs, self.r.cache)
+                     for e in entries]
+        finally:
+            self._occupy("marshal", 0)
+
+        tile = _Tile(start=start, end=end, fetched=fetched,
+                     entries=entries, metas=metas, pubs=pubs, msgs=msgs,
+                     sigs=sigs, valset_break=valset_break)
+        if not pubs:
+            tile.out = np.zeros((0,), dtype=bool)  # all cached/absent
+        elif self.watchdog is not None and self.watchdog.wedged:
+            # sticky drain: don't even dispatch to a wedged device
+            self.watchdog._fallback()
+            tile.out = self._cpu_verify(pubs, msgs, sigs)
+        else:
+            try:
+                tile.future = self.backend.submit(pubs, msgs, sigs)
+            except Exception as e:  # noqa: BLE001 — a dead device link
+                # at submit degrades exactly like a deadline miss
+                if self.watchdog is not None:
+                    self.watchdog._trip(e)
+                    self.watchdog._fallback()
+                tile.out = self._cpu_verify(pubs, msgs, sigs)
+                return tile
+            if self.metrics is not None:
+                self.metrics.tiles_dispatched.inc()
+        return tile
+
+    @staticmethod
+    def _cpu_verify(pubs, msgs, sigs) -> np.ndarray:
+        # the watchdog's drain target: native per-sig verify, never a
+        # device (or jit-compile) dependency
+        return verify_lanes(pubs, msgs, sigs, 0)
+
+    @staticmethod
+    def _cancel(tile: "_Tile") -> None:
+        """Abandon a dispatched tile's future (nothing will collect the
+        answer — without this, DeviceClient retains late verdicts in
+        _results forever)."""
+        fut = tile.future
+        if fut is not None:
+            cancel = getattr(fut, "cancel", None)
+            if cancel is not None:
+                cancel()
+
+    def _settle(self, tile: _Tile) -> None:
+        """Resolve the tile's verdicts (waiting on the dispatch under
+        the watchdog deadline; CPU fallback on wedge) and map them onto
+        entry.commit_ok."""
+        if tile.out is None:
+            if self.watchdog is not None:
+                out = self.watchdog.result(tile.future, tile.n_lanes)
+                if out is None:  # wedged: drain this tile to the CPU
+                    self._cancel(tile)
+                    out = self._cpu_verify(tile.pubs, tile.msgs,
+                                           tile.sigs)
+            else:
+                out = tile.future.result()
+            tile.out = np.asarray(out, dtype=bool)
+        settle_tile(tile.metas, tile.out, tile.pubs, tile.msgs,
+                    tile.sigs, self.r.cache)
+        if tile.entries:
+            self.r.stats.tiles_flushed += 1
+            self.r.stats.sigs_verified += sum(
+                1 for e in tile.entries for cs in e.commit.signatures
+                if not cs.absent_())
+
+    def _occupy(self, stage: str, n: int) -> None:
+        if self.metrics is not None:
+            self.metrics.stage_occupancy.set(n, stage=stage)
+
+    def _inflight_gauge(self, n: int) -> None:
+        if self.metrics is not None:
+            self.metrics.tiles_in_flight.set(n)
+            self.metrics.stage_occupancy.set(n, stage="dispatch")
+
+    # --- the run loop -----------------------------------------------------
+
+    def run(self, state: State, target: int) -> State:
+        """One catch-up pass: process tiles until target or failure.
+        Mirrors _sync_tile's contract: on a bad block the peer is
+        banned and either the partially-advanced state returns (caller
+        retries the remainder) or BlockValidationError raises when
+        nothing was applied this pass."""
+        r = self.r
+        inflight: "deque[_Tile]" = deque()
+        spec_vals = state.validators
+        next_start = state.last_block_height + 1
+        applied_any = False
+        barrier = False  # valset change seen: drain before refilling
+        try:
+            while state.last_block_height < target or inflight:
+                # fill: keep up to `depth` tiles fetched+marshaled+
+                # dispatched ahead of the apply stage
+                while (not barrier and len(inflight) < self.depth
+                       and next_start <= target):
+                    try:
+                        tile = self._build_tile(next_start, target,
+                                                spec_vals)
+                    except SyncStalled:
+                        if not inflight:
+                            raise
+                        break  # drain what we have; refill retries fetch
+                    inflight.append(tile)
+                    next_start = tile.end + 1
+                    if tile.valset_break:
+                        barrier = True
+                self._inflight_gauge(len(inflight))
+                if not inflight:
+                    if state.last_block_height >= target:
+                        break
+                    # barrier drained (or stall): resume speculation from
+                    # the now-current validator set
+                    barrier = False
+                    spec_vals = state.validators
+                    continue
+
+                tile = inflight.popleft()
+                self._inflight_gauge(len(inflight))
+                self._settle(tile)
+                self._occupy("apply", 1)
+                try:
+                    by_height = {e.height: e for e in tile.entries}
+                    h = tile.start
+                    while h <= tile.end:
+                        block, parts, block_id = tile.fetched[h]
+                        seal_commit = tile.fetched[h + 1][0].last_commit
+                        try:
+                            state = r._apply_one(
+                                state, h, block, parts, block_id,
+                                seal_commit, by_height.get(h))
+                        except TileApplyError as f:
+                            r.source.ban(h)
+                            # drop everything speculative: the remainder
+                            # refetches (possibly re-routed) in a fresh
+                            # pass; cancel abandoned dispatches so the
+                            # device client doesn't retain their answers
+                            for t in inflight:
+                                self._cancel(t)
+                            inflight.clear()
+                            if applied_any:
+                                return state
+                            raise BlockValidationError(str(f)) from f
+                        applied_any = True
+                        h += 1
+                finally:
+                    self._occupy("apply", 0)
+                if barrier and not inflight:
+                    barrier = False
+                    spec_vals = state.validators
+                    next_start = state.last_block_height + 1
+        finally:
+            self._inflight_gauge(0)
+        return state

@@ -261,6 +261,9 @@ class Simulation:
         self.nodes = [SimNode(i, k, self.gen, SIM_CONFIG, self.workdir)
                       for i, k in enumerate(keys)]
         self.deferred: set = set()
+        # scenario knob: non-empty => blocksync_join runs the PIPELINED
+        # engine ({"depth": K, "deadline_s": s, "backend_factory": fn})
+        self.blocksync_opts: Dict = {}
         self.commit_hashes: Dict[int, str] = {}
         self.crashes = 0
         self.restarts = 0
@@ -579,9 +582,22 @@ class Simulation:
             target = source.max_height()
             state = node.cs.state
             if target > state.last_block_height:
+                opts = self.blocksync_opts
+                wd = None
+                kwargs = {}
+                if opts:
+                    from ..pipeline.watchdog import DeviceWatchdog
+                    wd = DeviceWatchdog(
+                        base_deadline_s=opts.get("deadline_s", 0.02),
+                        per_sig_s=0.0)
+                    kwargs = dict(
+                        pipeline_depth=opts.get("depth", 2),
+                        backend=opts["backend_factory"](),
+                        watchdog=wd)
                 engine = BlocksyncEngine(
                     node.executor, node.block_store, source,
-                    self.gen.chain_id, tile_size=4, batch_size=0)
+                    self.gen.chain_id, tile_size=4, batch_size=0,
+                    **kwargs)
                 try:
                     state = engine.sync(state, target)
                 except Exception as e:  # noqa: BLE001 — type name only:
@@ -593,6 +609,13 @@ class Simulation:
                 self.log("blocksync", node=idx,
                          h=state.last_block_height,
                          applied=engine.stats.blocks_applied)
+                if wd is not None:
+                    # counts only (never wall times): the fallback tally
+                    # is a deterministic function of heights synced, so
+                    # the line is byte-stable per (scenario, seed)
+                    self.log("blocksync_wedge", node=idx,
+                             wedged=int(wd.wedged),
+                             fallbacks=wd.fallbacks)
                 if state is not node.cs.state:
                     node.cs.state = state
                     node.cs._update_to_state(state)

@@ -130,6 +130,19 @@ def _setup_blocksync_lag(sim: Simulation) -> None:
     sim.at(2400, lambda: sim.blocksync_join(0))
 
 
+def _setup_blocksync_wedge(sim: Simulation) -> None:
+    # node 0 joins late and catches up through the PIPELINED blocksync
+    # engine whose verify backend never answers (the wedged-TPU-tunnel
+    # model, docs/PERF.md): the watchdog must drain every tile to the
+    # CPU fallback and still complete the sync — a wedged device
+    # degrades catch-up speed, never liveness
+    from ..pipeline.scheduler import HangingBackend
+    sim.blocksync_opts = {"depth": 2, "deadline_s": 0.02,
+                          "backend_factory": HangingBackend}
+    sim.defer(0)
+    sim.at(2400, lambda: sim.blocksync_join(0))
+
+
 SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
     Scenario("baseline", "4 honest nodes, mild latency/jitter",
              target_height=5, deadline_ms=60_000,
@@ -162,6 +175,11 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
              "the real blocksync engine before consensus",
              target_height=6, deadline_ms=120_000,
              setup=_setup_blocksync_lag),
+    Scenario("blocksync-wedge", "late joiner syncs through the pipelined "
+             "engine with a hung verify device; the watchdog drains "
+             "every tile to the CPU fallback",
+             target_height=6, deadline_ms=120_000,
+             setup=_setup_blocksync_wedge),
 ]}
 
 

@@ -102,9 +102,18 @@ def _verify_commit_core(chain_id: str, vals: ValidatorSet, commit: Commit,
             vals.get_proposer().pub_key)
         use_batch = ok
 
+    # verified-signature cache (pipeline/cache): commits re-checked by
+    # the light client or blocksync's respeculation path skip signatures
+    # a previous pass already verified TRUE; cached lanes never reach
+    # the device and failed lanes are never cached, so verdicts are
+    # byte-identical with the uncached path
+    from ..pipeline.cache import shared_cache
+    cache = shared_cache()
+
     tallied = 0
     seen = {}
     batch_idxs = []
+    batch_items = []  # (pub_bytes, msg, sig) per device lane, for cache
     for idx, cs in enumerate(commit.signatures):
         if ignore(cs):
             continue
@@ -127,11 +136,17 @@ def _verify_commit_core(chain_id: str, vals: ValidatorSet, commit: Commit,
             seen[val_idx] = idx
 
         msg = commit.vote_sign_bytes(chain_id, idx)
-        if use_batch:
+        pkb = val.pub_key.bytes_()
+        if cache.seen(pkb, msg, cs.signature, path="commit"):
+            pass  # previously verified TRUE: no work either path
+        elif use_batch:
             bv.add(val.pub_key, msg, cs.signature)
             batch_idxs.append(idx)
-        elif not val.pub_key.verify_signature(msg, cs.signature):
-            raise ErrWrongSignature(idx, cs.signature)
+            batch_items.append((pkb, msg, cs.signature))
+        else:
+            if not val.pub_key.verify_signature(msg, cs.signature):
+                raise ErrWrongSignature(idx, cs.signature)
+            cache.add(pkb, msg, cs.signature)
 
         if count(cs):
             tallied += val.voting_power
@@ -143,6 +158,9 @@ def _verify_commit_core(chain_id: str, vals: ValidatorSet, commit: Commit,
 
     if use_batch and len(bv):
         all_ok, oks = bv.verify()
+        for (pkb, msg, sig), ok in zip(batch_items, oks):
+            if ok:
+                cache.add(pkb, msg, sig)
         if not all_ok:
             first_bad = next(i for i, o in zip(batch_idxs, oks) if not o)
             raise ErrWrongSignature(

@@ -68,6 +68,10 @@ class ValidatorSet:
     """Sorted validator set (by descending power, then ascending address —
     reference types/validator_set.go ValidatorsByVotingPower)."""
 
+    # class-level default so raw __new__ constructions (e.g. state
+    # deserialization) inherit an empty memo instead of AttributeError
+    _hash: Optional[bytes] = None
+
     def __init__(self, validators: List[Validator],
                  proposer: Optional[Validator] = None):
         vals = sorted((v.copy() for v in validators),
@@ -78,6 +82,7 @@ class ValidatorSet:
         if len(self._by_address) != len(vals):
             raise ValueError("duplicate validator address")
         self._total: Optional[int] = None
+        self._hash: Optional[bytes] = None
         if proposer is not None:
             idx = self._by_address.get(proposer.address)
             self.proposer: Optional[Validator] = (
@@ -120,9 +125,17 @@ class ValidatorSet:
 
     def hash(self) -> bytes:
         """merkle over SimpleValidator encodings
-        (reference types/validator_set.go:348-354)."""
-        return merkle.hash_from_byte_slices(
-            [v.bytes_() for v in self.validators])
+        (reference types/validator_set.go:348-354). Memoized: the hash
+        covers (pubkey, power) only — proposer-priority rotation does
+        not change it — and the one membership mutator
+        (update_with_change_set) invalidates, same discipline as
+        _total. Blocksync apply compares valset hashes per height, so
+        recomputing the merkle each call dominated the sequential
+        apply stage the pipeline cannot hide."""
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [v.bytes_() for v in self.validators])
+        return self._hash
 
     def get_proposer(self) -> Optional[Validator]:
         return self.proposer
@@ -132,6 +145,7 @@ class ValidatorSet:
         cp.validators = [v.copy() for v in self.validators]
         cp._by_address = {v.address: i for i, v in enumerate(cp.validators)}
         cp._total = self._total
+        cp._hash = self._hash
         cp.proposer = None
         if self.proposer is not None:
             idx = cp._by_address.get(self.proposer.address)
@@ -246,6 +260,7 @@ class ValidatorSet:
         self._by_address = {v.address: i
                             for i, v in enumerate(self.validators)}
         self._total = None
+        self._hash = None
         self.total_voting_power()
 
         self.rescale_priorities(

@@ -135,9 +135,22 @@ class VoteSet:
                 raise ErrVoteInvalidSignature(
                     f"failed to verify extended vote from {addr.hex()}")
         else:
-            if not vote.verify(self.chain_id, val.pub_key):
-                raise ErrVoteInvalidSignature(
-                    f"failed to verify vote from {addr.hex()}")
+            # re-gossiped votes hit the verified-signature cache instead
+            # of re-running the ~400µs verify (or burning a device lane);
+            # only verified-TRUE signatures are ever cached, so a hit
+            # can't flip a verdict
+            from ..pipeline.cache import shared_cache
+            cache = shared_cache()
+            pkb = val.pub_key.bytes_()
+            sb = vote.sign_bytes(self.chain_id)
+            if not cache.seen(pkb, sb, vote.signature, path="vote"):
+                # _precheck pinned addr == val.address, so Vote.verify's
+                # address check is redundant here — verify against the
+                # already-encoded sign bytes (one encode, not two)
+                if not val.pub_key.verify_signature(sb, vote.signature):
+                    raise ErrVoteInvalidSignature(
+                        f"failed to verify vote from {addr.hex()}")
+                cache.add(pkb, sb, vote.signature)
             if vote.extension or vote.extension_signature:
                 raise VoteError("unexpected vote extension data")
 
@@ -183,10 +196,34 @@ class VoteSet:
             bv, ok = crypto_batch.create_batch_verifier(pend[0][2].pub_key)
             if ok and all(val.pub_key.type_() == pend[0][2].pub_key.type_()
                           for _i, _v, val in pend):
-                for _i, v, val in pend:
-                    bv.add(val.pub_key, v.sign_bytes(self.chain_id),
-                           v.signature)
-                _, oks = bv.verify()
+                # verified-signature cache: a re-gossiped burst costs
+                # zero device lanes; only misses are marshaled, and
+                # verified-true lanes are written back
+                from ..pipeline.cache import shared_cache
+                cache = shared_cache()
+                marshal = [(val.pub_key.bytes_(),
+                            v.sign_bytes(self.chain_id), v.signature,
+                            val.pub_key)
+                           for _i, v, val in pend]
+                # fail-closed: every lane starts UNVERIFIED (None is
+                # falsy below); only a cache hit or an explicit verifier
+                # verdict marks it — a short lane_oks from a buggy
+                # backend must never admit an unchecked vote
+                oks = [None] * len(pend)
+                lanes = []                # positions needing the device
+                for pos, (pkb, sb, sig, pk) in enumerate(marshal):
+                    if cache.seen(pkb, sb, sig, path="vote"):
+                        oks[pos] = True
+                        continue
+                    bv.add(pk, sb, sig)
+                    lanes.append(pos)
+                if lanes:
+                    _, lane_oks = bv.verify()
+                    for pos, lane_ok in zip(lanes, lane_oks):
+                        oks[pos] = lane_ok
+                        if lane_ok:
+                            pkb, sb, sig, _pk = marshal[pos]
+                            cache.add(pkb, sb, sig)
             else:
                 bv = None
         if bv is None:

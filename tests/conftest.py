@@ -45,3 +45,6 @@ def pytest_configure(config):
         "markers", "slow: multi-process / perturbation tests")
     config.addinivalue_line(
         "markers", "sim: deterministic simnet scenarios (virtual time)")
+    config.addinivalue_line(
+        "markers", "pipeline: asynchronous multi-tile verification "
+        "pipeline (pipeline/scheduler, watchdog, sig cache)")

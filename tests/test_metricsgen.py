@@ -3,12 +3,16 @@ libs/metrics_gen.py; reference scripts/metricsgen/metricsgen.go +
 the CI check that metrics.gen.go is current)."""
 
 from cometbft_tpu.libs.metrics import Registry
-from cometbft_tpu.libs.metrics_gen import MempoolMetrics, P2PMetrics
+from cometbft_tpu.libs.metrics_gen import (MempoolMetrics, P2PMetrics,
+                                           PipelineMetrics)
 
 
 def test_generated_file_is_current():
     """The committed metrics_gen.py must match the spec — the same
-    freshness gate the reference runs over metrics.gen.go."""
+    freshness gate the reference runs over metrics.gen.go. Covers every
+    spec'd struct, PipelineMetrics included."""
+    from cometbft_tpu.libs.metrics_defs import METRICS_SPEC
+    assert "PipelineMetrics" in METRICS_SPEC
     from tools.metricsgen import main
     assert main(["--check"]) == 0
 
@@ -17,15 +21,22 @@ def test_generated_structs_register_and_expose():
     reg = Registry()
     p2p = P2PMetrics(reg)
     mp = MempoolMetrics(reg)
+    pl = PipelineMetrics(reg)
     p2p.peers.set(3)
     p2p.message_send_bytes_total.inc(128, ch_id="0x20")
     mp.size.set(7)
     mp.failed_txs.inc()
+    pl.tiles_in_flight.set(4)
+    pl.cache_hits.inc(path="vote")
+    pl.wedge_fallbacks.inc()
     text = reg.expose()
     assert "cometbft_tpu_p2p_peers 3" in text
     assert 'ch_id="0x20"' in text
     assert "cometbft_tpu_mempool_size 7" in text
     assert "cometbft_tpu_mempool_failed_txs 1" in text
+    assert "cometbft_tpu_pipeline_tiles_in_flight 4" in text
+    assert 'cometbft_tpu_pipeline_sigcache_hits{path="vote"} 1' in text
+    assert "cometbft_tpu_pipeline_wedge_fallbacks 1" in text
 
 
 def test_mempool_wiring_moves_gauges():

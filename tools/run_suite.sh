@@ -23,4 +23,10 @@ echo "=== suite 2/2: ${#SECOND[@]} modules (p-z) ===" >&2
 python -m pytest "${SECOND[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
 echo "=== simnet selftest (determinism + crash recovery) ===" >&2
 python tools/sim_run.py --selftest || rc=$?
+# suite 2/2 already covers the slow-marked pipeline soak on a default
+# (unfiltered) run; this explicit step guarantees the depth sweep even
+# when the caller filtered the main suites (e.g. -m 'not slow'), so no
+# extra ARGS are forwarded here.
+echo "=== pipeline depth-sweep soak (K in {1,2,4,8}) ===" >&2
+python -m pytest tests/test_pipeline.py -q -m slow || rc=$?
 exit $rc
