@@ -197,6 +197,29 @@ class BlockSyncConfig:
 
 
 @dataclass
+class DeviceConfig:
+    """Verification-device health supervision (device/health.py): how
+    aggressively a SUSPECT device is re-probed with known-answer
+    batches, and whether canary lanes ride every device batch. The env
+    knobs COMETBFT_TPU_DEVICE_BACKOFF_BASE/_CAP/_PROBE_DEADLINE/_CANARY
+    serve the same role for processes booted without a config file."""
+    canary: bool = True                 # known-good/bad lanes per batch
+    probe_backoff_base_ms: int = 500    # first half-open window
+    probe_backoff_cap_ms: int = 30_000  # exponential backoff ceiling
+    probe_deadline_ms: int = 2_000      # per-probe answer deadline
+
+    def validate_basic(self) -> None:
+        if self.probe_backoff_base_ms <= 0:
+            raise ValueError(
+                "device.probe_backoff_base_ms must be positive")
+        if self.probe_backoff_cap_ms < self.probe_backoff_base_ms:
+            raise ValueError("device.probe_backoff_cap_ms must be >= "
+                             "probe_backoff_base_ms")
+        if self.probe_deadline_ms <= 0:
+            raise ValueError("device.probe_deadline_ms must be positive")
+
+
+@dataclass
 class StorageConfig:
     """reference config/config.go StorageConfig."""
     discard_abci_responses: bool = False   # drop FinalizeBlock responses
@@ -253,6 +276,7 @@ class Config:
     mempool: MempoolConfig = dc_field(default_factory=MempoolConfig)
     statesync: StateSyncConfig = dc_field(default_factory=StateSyncConfig)
     blocksync: BlockSyncConfig = dc_field(default_factory=BlockSyncConfig)
+    device: DeviceConfig = dc_field(default_factory=DeviceConfig)
     consensus: ConsensusTimeoutsConfig = dc_field(
         default_factory=ConsensusTimeoutsConfig)
     storage: StorageConfig = dc_field(default_factory=StorageConfig)
@@ -285,6 +309,7 @@ class Config:
         self.rpc.validate_basic()
         self.statesync.validate_basic()
         self.blocksync.validate_basic()
+        self.device.validate_basic()
         self.storage.validate_basic()
         self.tx_index.validate_basic()
         self.grpc.validate_basic()
@@ -314,6 +339,7 @@ class Config:
             emit("rpc", self.rpc), emit("mempool", self.mempool),
             emit("statesync", self.statesync),
             emit("blocksync", self.blocksync),
+            emit("device", self.device),
             emit("consensus", self.consensus),
             emit("storage", self.storage),
             emit("tx_index", self.tx_index),
@@ -329,6 +355,7 @@ class Config:
                                 ("mempool", cfg.mempool),
                                 ("statesync", cfg.statesync),
                                 ("blocksync", cfg.blocksync),
+                                ("device", cfg.device),
                                 ("consensus", cfg.consensus),
                                 ("storage", cfg.storage),
                                 ("tx_index", cfg.tx_index),

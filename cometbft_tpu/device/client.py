@@ -13,6 +13,8 @@ import socket
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..libs.env import env_float
+from . import health
 from .protocol import (decode_response, encode_request, recv_frame,
                        send_frame)
 
@@ -30,13 +32,8 @@ DEFAULT_DEADLINE_PER_SIG_S = 0.005
 
 def deadline_for(n_lanes: int) -> float:
     """Batch-size-scaled per-request deadline for a device round trip."""
-    try:
-        base = float(os.environ.get(ENV_DEADLINE_BASE,
-                                    DEFAULT_DEADLINE_BASE_S))
-        per = float(os.environ.get(ENV_DEADLINE_PER_SIG,
-                                   DEFAULT_DEADLINE_PER_SIG_S))
-    except ValueError:
-        base, per = DEFAULT_DEADLINE_BASE_S, DEFAULT_DEADLINE_PER_SIG_S
+    base = env_float(ENV_DEADLINE_BASE, DEFAULT_DEADLINE_BASE_S)
+    per = env_float(ENV_DEADLINE_PER_SIG, DEFAULT_DEADLINE_PER_SIG_S)
     return base + per * max(0, n_lanes)
 
 
@@ -205,21 +202,42 @@ def shared_client() -> Optional[DeviceClient]:
     (one socket per process; the server coalesces across processes).
     A dead link is dropped so the next call can reconnect; connect uses
     a short timeout — an unreachable server must not stall the
-    consensus-path caller, which falls back to in-process verification."""
+    consensus-path caller, which falls back to in-process verification.
+
+    Reconnects are supervisor-driven (device/health.py): a quarantined
+    device never reconnects, and repeated connect failures ride the
+    supervisor's jittered exponential backoff instead of paying the
+    connect timeout on every verify call."""
     global _shared
     addr = os.environ.get(ENV_VAR, "")
     if not addr:
         return None
+    sup = health.shared_supervisor()
     with _shared_lock:
+        if sup.quarantined():
+            # corrupt verdicts: no caller may use the device, and the
+            # open socket (plus its recv thread) to the condemned
+            # server is torn down so nothing can submit to it again
+            if _shared is not None:
+                _shared.close()
+                _shared = None
+            return None
         if _shared is not None and _shared._dead is not None:
             _shared.close()
             _shared = None
         if _shared is None:
+            if not sup.allow_connect():
+                return None
             host, _, port = addr.rpartition(":")
             try:
                 _shared = DeviceClient(host or "127.0.0.1", int(port),
                                        timeout=2.0)
-            except (OSError, ValueError):
+            except ValueError:
+                return None
+            except OSError as e:
+                # backoff: the NEXT caller skips the connect attempt
+                # until the supervisor's half-open window elapses
+                sup.report_trip(e)
                 return None
         return _shared
 
@@ -228,10 +246,17 @@ class RemoteBatchVerifier:
     """crypto.BatchVerifier backed by the device server, with an
     in-process fallback: a dead/slow/unwilling server degrades to local
     verification — it must never surface transport errors (or worse,
-    false signature verdicts) into commit/vote verification."""
+    false signature verdicts) into commit/vote verification.
 
-    def __init__(self, client: DeviceClient):
+    False verdicts are the supervisor's canary-lane job: every device
+    batch carries a known-good + known-bad signature pair (stripped
+    from the results); a canary mismatch quarantines the device for the
+    process and THIS batch verifies locally — a corrupt verdict can
+    never reach a commit decision through this seam."""
+
+    def __init__(self, client: DeviceClient, supervisor=None):
         self._client = client
+        self._supervisor = supervisor  # None → shared_supervisor()
         self._pubs: List[bytes] = []
         self._msgs: List[bytes] = []
         self._sigs: List[bytes] = []
@@ -254,25 +279,60 @@ class RemoteBatchVerifier:
     def verify(self) -> Tuple[bool, List[bool]]:
         if not self._pubs:
             return False, []
+        sup = self._supervisor or health.shared_supervisor()
+        granted = False  # a reconnect already claimed this attempt
         for attempt in (0, 1):
+            if not granted and not sup.allow_connect():
+                # quarantined (a device that lied once is never asked
+                # again), or SUSPECT inside its backoff window: while
+                # half-open, only the elapsed-window attempt may reach
+                # the device — every other consensus-path batch goes
+                # straight local instead of paying the full scaled
+                # deadline against a known-suspect server
+                break
+            granted = False
+            pubs, msgs, sigs = self._pubs, self._msgs, self._sigs
+            canaried = sup.canary
+            if canaried:
+                pubs, msgs, sigs = health.splice_canaries(pubs, msgs,
+                                                          sigs)
             try:
-                return self._client.verify(self._pubs, self._msgs,
-                                           self._sigs)
+                batch_ok, oks = self._client.verify(pubs, msgs, sigs)
             except DeviceUnprocessable:
                 break  # a retry cannot shrink the batch: go local now
-            except TimeoutError:
+            except TimeoutError as e:
                 # the server is wedged but the socket is up: a second
                 # attempt would hit the same wedge and DOUBLE the
                 # consensus-path stall this deadline exists to bound
+                sup.report_trip(e)
                 break
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
+                sup.report_trip(e)
                 if attempt:
                     break
-                # one retry before abandoning the device: a dead link
-                # may reconnect through shared_client() (the env-based
-                # singleton drops dead links on each call, and an
-                # unreachable server fails the connect fast)
+                # one retry before abandoning the device, riding the
+                # FRESH reconnect: shared_client() drops dead links,
+                # honors the supervisor's half-open window (the first
+                # trip allows one immediate attempt), and an
+                # unreachable server fails the connect fast
                 fresh = shared_client()
                 if fresh is not None:
                     self._client = fresh
+                    # the reconnect's allow_connect claimed the
+                    # half-open window; this retry IS that attempt
+                    granted = True
+                continue
+            if canaried:
+                ok, oks = health.check_canaries(oks, len(self._pubs))
+                if not ok:
+                    sup.report_corruption("batch canary mismatch")
+                    break  # local re-verify below: verdicts untrusted
+                # the server's batch_ok covered the known-bad canary;
+                # recompute over the real lanes
+                batch_ok = bool(oks) and all(oks)
+            # with canaries this batch is verdict-verified; without,
+            # the operator opted out of verdict checks and a completed
+            # round trip still clears a transport-level SUSPECT
+            sup.report_success()
+            return batch_ok, oks
         return self._local()

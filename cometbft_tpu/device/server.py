@@ -23,6 +23,7 @@ import threading
 from dataclasses import dataclass
 from typing import List, Optional
 
+from .health import CANARY_LANES
 from .protocol import (decode_request, encode_response, recv_frame,
                        send_frame)
 
@@ -135,6 +136,18 @@ class DeviceServer:
                 lanes += len(nxt.pubs)
             self._flush(batch)
 
+    def _unprocessable(self, pubs: List[bytes], msgs: List[bytes]
+                       ) -> bool:
+        """Reject what the compiled bucket cannot serve. Canary lanes
+        (device/health) ride ON TOP of a caller's bucket-sized payload,
+        so the lane cap grants them headroom — without it, a batch that
+        exactly filled the bucket before canaries would bounce as
+        UNPROCESSABLE and trip the supervisor into a SUSPECT/HEALTHY
+        flap. verify_batch chunks past the bucket; the kernel shape
+        never changes."""
+        return (any(len(m) > self.max_msg_len for m in msgs)
+                or len(pubs) > self.bucket + CANARY_LANES)
+
     # --- socket side ----------------------------------------------------------
 
     def _serve_conn(self, sock: socket.socket) -> None:
@@ -149,8 +162,7 @@ class DeviceServer:
                 # nonzero request — distinct from per-lane failure, so
                 # clients fall back locally instead of treating valid
                 # signatures as forged)
-                if any(len(m) > self.max_msg_len for m in msgs) or \
-                        len(pubs) > self.bucket:
+                if self._unprocessable(pubs, msgs):
                     with wlock:
                         send_frame(sock, encode_response(
                             req_id, False, []))

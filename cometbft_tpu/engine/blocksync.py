@@ -211,7 +211,7 @@ class BlocksyncReactor:
                  source: PeerSource, chain_id: str, tile_size: int = 32,
                  batch_size: int = 4096, max_retries: int = 3,
                  pipeline_depth: int = 1, backend=None, watchdog=None,
-                 cache=None, metrics=None):
+                 cache=None, metrics=None, supervisor=None):
         self.executor = executor
         self.store = store
         self.source = source
@@ -224,6 +224,7 @@ class BlocksyncReactor:
         self.watchdog = watchdog    # pipeline.watchdog.DeviceWatchdog
         self.cache = cache          # pipeline.cache.SigCache
         self.metrics = metrics      # libs/metrics_gen.PipelineMetrics
+        self.supervisor = supervisor  # device/health.DeviceSupervisor
         self.stats = SyncStats()
         # [height, commit, digest|None] of the last tile-verified seal,
         # keyed by the height of the block that CARRIES it as last_commit.
@@ -251,7 +252,8 @@ class BlocksyncReactor:
             from ..pipeline.scheduler import PipelinedBlocksync
             pipe = PipelinedBlocksync(
                 self, depth=self.pipeline_depth, backend=self.backend,
-                watchdog=self.watchdog, metrics=self.metrics)
+                watchdog=self.watchdog, metrics=self.metrics,
+                supervisor=self.supervisor)
             step = pipe.run
         retries = 0
         try:

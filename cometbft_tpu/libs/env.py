@@ -1,0 +1,37 @@
+"""Tolerant environment-knob parsing shared by every tunable subsystem.
+
+A malformed env override must degrade to the compiled-in default, never
+abort node boot or blocksync startup: operators fat-finger
+`COMETBFT_TPU_*` knobs in systemd units and container manifests, and a
+ValueError from deep inside the verify path would turn a typo into an
+outage. Previously this guard was copy-pasted in pipeline/watchdog.py
+and device/client.py (with subtly different blast radius — the client
+variant reset BOTH knobs when either was malformed); it lives here once
+and also serves the device-health backoff knobs.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    """float(os.environ[name]) with `default` for unset OR malformed."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Boolean knob: 1/true/yes/on (any case) is True, 0/false/no/off
+    is False, unset or unrecognized is `default`."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    val = raw.strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    return default

@@ -50,6 +50,21 @@ METRICS_SPEC = {
         ("counter", "cache_evictions", "pipeline_sigcache_evictions",
          "Verified-signature cache LRU evictions", ()),
     ],
+    # device/health.py — the verification-backend health supervisor
+    # (HEALTHY=0 SUSPECT=1 PROBING=2 QUARANTINED=3 state machine,
+    # known-answer probes, canary-lane corruption detection)
+    "DeviceMetrics": [
+        ("gauge", "health_state", "device_health_state",
+         "Verify-backend health state (0=healthy 1=suspect 2=probing "
+         "3=quarantined)", ()),
+        ("counter", "probes_total", "device_probes_total",
+         "Known-answer probe batches sent to a suspect verify backend",
+         ()),
+        ("counter", "quarantines_total", "device_quarantines_total",
+         "Terminal verify-backend quarantines (corrupt verdicts)", ()),
+        ("counter", "canary_failures", "device_canary_failures",
+         "Device batches whose canary lanes answered wrong", ()),
+    ],
     # reference mempool/metrics.go
     "MempoolMetrics": [
         ("gauge", "size", "mempool_size",

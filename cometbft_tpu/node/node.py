@@ -197,11 +197,20 @@ class Node:
         # libs/metrics_defs.py — the reference's scripts/metricsgen
         # role): mempool occupancy now, p2p wiring after the switch
         # exists below
-        from ..libs.metrics_gen import (MempoolMetrics, P2PMetrics,
-                                        PipelineMetrics)
+        from ..libs.metrics_gen import (DeviceMetrics, MempoolMetrics,
+                                        P2PMetrics, PipelineMetrics)
         self._p2p_metrics_cls = P2PMetrics
         self.mempool.metrics = MempoolMetrics(self.metrics_registry)
         self.pipeline_metrics = PipelineMetrics(self.metrics_registry)
+        self.device_metrics = DeviceMetrics(self.metrics_registry)
+        # the per-process device health supervisor (device/health.py):
+        # wedge recovery probing, canary-verified batches, reconnect
+        # backoff. Knobs from [device]; first node wins for metrics and
+        # configuration (several in-process nodes share one device),
+        # matching the shared-cache posture below.
+        from ..device.health import shared_supervisor
+        shared_supervisor().configure(config.device,
+                                      metrics=self.device_metrics)
         # the process-wide verified-signature cache (vote intake, light
         # client, blocksync) reports hit/miss/eviction through the same
         # struct. First node wins: with several nodes in one process
@@ -483,26 +492,33 @@ class Node:
             batch = self._device_batch_size()
             depth = (self.config.blocksync.pipeline_depth
                      if batch > 0 else 1)
-            watchdog = backend = None
+            watchdog = backend = supervisor = None
             if depth > 1:
                 from ..pipeline.watchdog import DeviceWatchdog
-                watchdog = DeviceWatchdog(
-                    metrics=self.pipeline_metrics)
                 # with the host's TPU-owner server configured, dispatch
                 # through the non-blocking DeviceClient.submit() seam;
                 # otherwise the scheduler's in-process dispatch thread
-                # drives the local JAX kernels
+                # drives the local JAX kernels. The health supervisor
+                # (and its canary lanes) only applies to the remote
+                # link — in-process dispatch has no transport to
+                # supervise, so it keeps the standalone sticky watchdog
                 from ..device.client import shared_client
                 client = shared_client()
                 if client is not None:
+                    from ..device.health import shared_supervisor
                     from ..pipeline.scheduler import DeviceClientBackend
+                    supervisor = shared_supervisor()
                     backend = DeviceClientBackend(client)
+                watchdog = DeviceWatchdog(
+                    metrics=self.pipeline_metrics,
+                    supervisor=supervisor)
             engine = BlocksyncReactor(
                 self.executor, self.block_store, pooled,
                 self.genesis.chain_id, tile_size=16,
                 batch_size=batch, pipeline_depth=depth,
                 backend=backend, watchdog=watchdog,
-                cache=shared_cache(), metrics=self.pipeline_metrics)
+                cache=shared_cache(), metrics=self.pipeline_metrics,
+                supervisor=supervisor)
             try:
                 state = engine.sync(state, target)
             except (BlockValidationError, SyncStalled):

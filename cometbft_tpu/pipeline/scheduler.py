@@ -26,9 +26,17 @@ change re-verifies on hash mismatch exactly as the current tile loop
 does. `depth=1` IS the synchronous path, one tile at a time.
 
 Wedge handling: every dispatch is bounded by the DeviceWatchdog; a
-deadline miss drains this and all in-flight tiles to a sticky CPU
-fallback (native per-signature verify) so a wedged TPU tunnel degrades
-catch-up speed, never liveness.
+deadline miss drains this and all in-flight tiles to the CPU fallback
+(native per-signature verify) so a wedged TPU tunnel degrades catch-up
+speed, never liveness. With a DeviceSupervisor attached (device/
+health.py) the drain is no longer a one-way door: the scheduler probes
+the suspect device with a cheap known-answer batch once per backoff
+window and resumes device dispatch when the supervisor returns to
+HEALTHY. The supervisor also arms canary lanes — a known-good and
+known-bad signature spliced into every device batch and stripped from
+the results; a canary verdict mismatch quarantines the device (terminal)
+and re-verifies that whole batch on CPU, so device results are never
+trusted un-canaried.
 """
 
 from __future__ import annotations
@@ -41,9 +49,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..device import health
 from ..engine.blocksync import (BlocksyncReactor, SyncStalled,
                                 TileApplyError, TileEntry, marshal_commit,
                                 settle_tile, verify_lanes)
+from ..libs.fail import fail_point
 from ..state.execution import BlockValidationError
 from ..state.state import State
 
@@ -115,6 +125,16 @@ class LocalAsyncBackend:
         self._thread.join(timeout=2.0)
 
 
+class ReconnectBlocked(health.AccountedTransportError):
+    """shared_client() could not produce a link: either the connect
+    attempt failed (that failure already reported a trip to the
+    supervisor) or the half-open backoff window is still closed (no
+    attempt was made, so there is no new failure to report). Either
+    way neither the dispatch fallback nor supervisor.probe() may
+    report a second trip — doing so would double-count one outage and
+    deepen the backoff twice."""
+
+
 class DeviceClientBackend:
     """Dispatch to the host's TPU-owner device server through the
     non-blocking DeviceClient.submit() seam; result() adapts the
@@ -138,7 +158,19 @@ class DeviceClientBackend:
         self._client = client
 
     def submit(self, pubs, msgs, sigs):
-        return self._Adapter(self._client.submit(pubs, msgs, sigs))
+        c = self._client
+        if c is None or c._dead is not None:
+            # ride the supervisor-gated reconnect: shared_client()
+            # drops dead links and honors the half-open backoff — this
+            # is what lets a probe reach a RESTARTED device server
+            # instead of re-trying the socket this backend was built on
+            from ..device.client import shared_client
+            c = shared_client()
+            if c is None:
+                raise ReconnectBlocked(
+                    "device link down, no reconnect")
+            self._client = c
+        return self._Adapter(c.submit(pubs, msgs, sigs))
 
     def close(self) -> None:
         pass  # the client is shared process-wide; never closed here
@@ -199,6 +231,56 @@ class HangingBackend:
         self.release()  # unblock anything still waiting
 
 
+class FlakyBackend:
+    """Transient-stall fixture (the device-flap model): the first
+    `fail_dispatches` submits raise ConnectionError, after which every
+    submit answers synchronously with CPU-computed verdicts — so a
+    supervisor's half-open probe succeeds once the flap passes and the
+    scheduler resumes device dispatch. Synchronous resolution keeps
+    simnet logs byte-identical (no wall-clock timer threads)."""
+
+    def __init__(self, fail_dispatches: int = 1, verify_fn=None):
+        self._verify = verify_fn or (
+            lambda p, m, s: verify_lanes(p, m, s, 0))
+        self.fail_left = fail_dispatches
+        self.dispatches = 0
+        self.served = 0  # successful answers (post-recovery activity)
+
+    def submit(self, pubs, msgs, sigs) -> VerifyFuture:
+        self.dispatches += 1
+        if self.fail_left > 0:
+            self.fail_left -= 1
+            raise ConnectionError("device stalled (flap)")
+        fut = VerifyFuture()
+        fut.set_result(self._verify(pubs, msgs, sigs))
+        self.served += 1
+        return fut
+
+    def close(self) -> None:
+        pass
+
+
+class CorruptBackend:
+    """The silently-corrupt device model: answers every lane True
+    regardless of the signature — exactly the failure a canary lane
+    exists to catch (the known-bad canary comes back True). Answers
+    synchronously for simnet determinism."""
+
+    def __init__(self):
+        self.dispatches = 0
+        self.served = 0
+
+    def submit(self, pubs, msgs, sigs) -> VerifyFuture:
+        self.dispatches += 1
+        self.served += 1
+        fut = VerifyFuture()
+        fut.set_result([True] * len(pubs))
+        return fut
+
+    def close(self) -> None:
+        pass
+
+
 # --- the scheduler ------------------------------------------------------------
 
 @dataclass
@@ -214,6 +296,7 @@ class _Tile:
     future: object = None            # None => out already final
     out: Optional[np.ndarray] = None
     valset_break: bool = False       # a header announced a new valset
+    n_canaries: int = 0              # canary lanes appended at dispatch
 
     @property
     def n_lanes(self) -> int:
@@ -228,7 +311,8 @@ class PipelinedBlocksync:
     paths share every stage implementation and all bookkeeping."""
 
     def __init__(self, reactor: BlocksyncReactor, depth: int = 4,
-                 backend=None, watchdog=None, metrics=None):
+                 backend=None, watchdog=None, metrics=None,
+                 supervisor=None):
         self.r = reactor
         self.depth = max(1, depth)
         self._own_backend = backend is None
@@ -237,6 +321,10 @@ class PipelinedBlocksync:
                 p, m, s, reactor.verifier.batch_size))
         self.watchdog = watchdog
         self.metrics = metrics
+        self.supervisor = supervisor  # device/health.DeviceSupervisor
+        if supervisor is not None and watchdog is not None \
+                and watchdog.supervisor is None:
+            watchdog.supervisor = supervisor
 
     def close(self) -> None:
         if self._own_backend:
@@ -283,23 +371,67 @@ class PipelinedBlocksync:
                      sigs=sigs, valset_break=valset_break)
         if not pubs:
             tile.out = np.zeros((0,), dtype=bool)  # all cached/absent
-        elif self.watchdog is not None and self.watchdog.wedged:
-            # sticky drain: don't even dispatch to a wedged device
-            self.watchdog._fallback()
+        elif self._device_blocked():
+            # wedged/suspect/quarantined (and no probe recovered it):
+            # don't even dispatch — drain this tile straight to the CPU
+            if self.watchdog is not None:
+                self.watchdog._fallback()
             tile.out = self._cpu_verify(pubs, msgs, sigs)
         else:
+            d_pubs, d_msgs, d_sigs = pubs, msgs, sigs
+            if self.supervisor is not None and self.supervisor.canary:
+                # canary lanes ride every device batch; tile.pubs stays
+                # canary-free for the CPU re-verify path
+                d_pubs, d_msgs, d_sigs = health.splice_canaries(
+                    pubs, msgs, sigs)
+                tile.n_canaries = health.CANARY_LANES
+            fail_point("pipeline:dispatch")
             try:
-                tile.future = self.backend.submit(pubs, msgs, sigs)
+                tile.future = self.backend.submit(d_pubs, d_msgs, d_sigs)
             except Exception as e:  # noqa: BLE001 — a dead device link
-                # at submit degrades exactly like a deadline miss
+                # at submit degrades exactly like a deadline miss;
+                # ReconnectBlocked was already accounted inside
+                # shared_client(), so only count the fallback for it
+                tile.n_canaries = 0
+                accounted = isinstance(e, health.AccountedTransportError)
                 if self.watchdog is not None:
-                    self.watchdog._trip(e)
+                    if not accounted:
+                        self.watchdog._trip(e)
                     self.watchdog._fallback()
+                elif self.supervisor is not None and not accounted:
+                    self.supervisor.report_trip(e)
                 tile.out = self._cpu_verify(pubs, msgs, sigs)
                 return tile
             if self.metrics is not None:
                 self.metrics.tiles_dispatched.inc()
         return tile
+
+    def _device_blocked(self) -> bool:
+        """Decide whether this tile may dispatch to the device. The
+        supervisor path is half-open: a due probe runs ONE cheap
+        known-answer batch against the backend; success resumes device
+        dispatch immediately (this very tile)."""
+        sup = self.supervisor
+        if sup is None:
+            return self.watchdog is not None and self.watchdog.wedged
+        if sup.can_dispatch():
+            return False
+        if sup.probe_due():
+            return not sup.probe(self._probe_verify)
+        return True
+
+    def _probe_verify(self, pubs, msgs, sigs):
+        """supervisor.probe adapter: one backend round trip under the
+        probe deadline; exceptions (timeout, transport) propagate to
+        the supervisor, which deepens the backoff."""
+        fut = self.backend.submit(pubs, msgs, sigs)
+        try:
+            return fut.result(self.supervisor.probe_deadline_s)
+        except BaseException:
+            cancel = getattr(fut, "cancel", None)
+            if cancel is not None:
+                cancel()
+            raise
 
     @staticmethod
     def _cpu_verify(pubs, msgs, sigs) -> np.ndarray:
@@ -323,14 +455,17 @@ class PipelinedBlocksync:
         the watchdog deadline; CPU fallback on wedge) and map them onto
         entry.commit_ok."""
         if tile.out is None:
+            total = tile.n_lanes + tile.n_canaries
             if self.watchdog is not None:
-                out = self.watchdog.result(tile.future, tile.n_lanes)
+                out = self.watchdog.result(tile.future, total)
                 if out is None:  # wedged: drain this tile to the CPU
                     self._cancel(tile)
                     out = self._cpu_verify(tile.pubs, tile.msgs,
                                            tile.sigs)
+                else:
+                    out = self._canary_check(tile, out)
             else:
-                out = tile.future.result()
+                out = self._canary_check(tile, tile.future.result())
             tile.out = np.asarray(out, dtype=bool)
         settle_tile(tile.metas, tile.out, tile.pubs, tile.msgs,
                     tile.sigs, self.r.cache)
@@ -339,6 +474,26 @@ class PipelinedBlocksync:
             self.r.stats.sigs_verified += sum(
                 1 for e in tile.entries for cs in e.commit.signatures
                 if not cs.absent_())
+
+    def _canary_check(self, tile: _Tile, out):
+        """Strip + verify this tile's canary lanes. A mismatch means
+        the device returned corrupt VERDICTS (not a transport failure):
+        quarantine it and re-verify the whole batch on CPU — a device
+        answer is never trusted un-canaried. A correct answer reports
+        success (PROBING → HEALTHY after a mid-probe full batch)."""
+        if not tile.n_canaries:
+            return out
+        ok, stripped = health.check_canaries(out, tile.n_lanes)
+        if ok:
+            if self.supervisor is not None:
+                self.supervisor.report_success()
+            return stripped
+        if self.supervisor is not None:
+            self.supervisor.report_corruption(
+                f"tile {tile.start}..{tile.end} canary mismatch")
+        if self.watchdog is not None:
+            self.watchdog._fallback()  # count the drain like a wedge
+        return self._cpu_verify(tile.pubs, tile.msgs, tile.sigs)
 
     def _occupy(self, stage: str, n: int) -> None:
         if self.metrics is not None:

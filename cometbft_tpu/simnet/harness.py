@@ -583,21 +583,29 @@ class Simulation:
             state = node.cs.state
             if target > state.last_block_height:
                 opts = self.blocksync_opts
-                wd = None
+                wd = sup = backend = None
                 kwargs = {}
                 if opts:
                     from ..pipeline.watchdog import DeviceWatchdog
+                    if "supervisor" in opts:
+                        # device health supervision under test: the
+                        # supervisor's clock is timesource.monotonic =
+                        # the VIRTUAL clock, so backoff windows elapse
+                        # deterministically as fetches pump the queue
+                        from ..device.health import DeviceSupervisor
+                        sup = DeviceSupervisor(**opts["supervisor"])
+                    backend = opts["backend_factory"]()
                     wd = DeviceWatchdog(
                         base_deadline_s=opts.get("deadline_s", 0.02),
-                        per_sig_s=0.0)
+                        per_sig_s=0.0, supervisor=sup)
                     kwargs = dict(
                         pipeline_depth=opts.get("depth", 2),
-                        backend=opts["backend_factory"](),
-                        watchdog=wd)
+                        backend=backend, watchdog=wd, supervisor=sup)
                 engine = BlocksyncEngine(
                     node.executor, node.block_store, source,
-                    self.gen.chain_id, tile_size=4, batch_size=0,
-                    **kwargs)
+                    self.gen.chain_id,
+                    tile_size=(opts.get("tile_size", 4) if opts else 4),
+                    batch_size=0, **kwargs)
                 try:
                     state = engine.sync(state, target)
                 except Exception as e:  # noqa: BLE001 — type name only:
@@ -616,6 +624,18 @@ class Simulation:
                     self.log("blocksync_wedge", node=idx,
                              wedged=int(wd.wedged),
                              fallbacks=wd.fallbacks)
+                if sup is not None:
+                    # the supervisor's verdict on the device after the
+                    # sync: state + probe/quarantine tallies, plus how
+                    # many batches the backend actually answered
+                    # (served > fail count proves device dispatch
+                    # RESUMED after recovery) — all counts, byte-stable
+                    self.log("blocksync_device", node=idx,
+                             state=sup.state_name(), trips=sup.trips,
+                             probes=sup.probes,
+                             quarantines=sup.quarantines,
+                             canary_failures=sup.canary_failures,
+                             served=getattr(backend, "served", 0))
                 if state is not node.cs.state:
                     node.cs.state = state
                     node.cs._update_to_state(state)

@@ -130,6 +130,41 @@ def _setup_blocksync_lag(sim: Simulation) -> None:
     sim.at(2400, lambda: sim.blocksync_join(0))
 
 
+def _setup_device_flap(sim: Simulation) -> None:
+    # node 0 joins late; its verify device STALLS transiently (the first
+    # two submits raise) and then recovers. The supervisor must take the
+    # device HEALTHY → SUSPECT on the trip, CPU-fallback the affected
+    # tiles, half-open probe it on the (virtual-time) backoff schedule,
+    # and RESUME device dispatch — the wedge is no longer a one-way
+    # door. tile_size=1 gives enough dispatch opportunities within a
+    # short catch-up for the whole arc to play out.
+    from ..pipeline.scheduler import FlakyBackend
+    sim.blocksync_opts = {
+        "depth": 2, "deadline_s": 0.5, "tile_size": 1,
+        "backend_factory": lambda: FlakyBackend(fail_dispatches=2),
+        "supervisor": {"backoff_base_s": 0.004, "backoff_cap_s": 0.1,
+                       "probe_deadline_s": 0.5, "canary": True}}
+    sim.defer(0)
+    sim.at(3600, lambda: sim.blocksync_join(0))
+
+
+def _setup_device_corrupt(sim: Simulation) -> None:
+    # node 0 joins late; its verify device ANSWERS but answers WRONG
+    # (all-true regardless of the signature). The known-bad canary lane
+    # spliced into the first batch must expose it: the supervisor
+    # quarantines the device (terminal), the batch is re-verified on
+    # CPU, and no corrupted verdict can reach commit verification —
+    # every remaining tile verifies on the CPU fallback.
+    from ..pipeline.scheduler import CorruptBackend
+    sim.blocksync_opts = {
+        "depth": 2, "deadline_s": 0.5, "tile_size": 2,
+        "backend_factory": CorruptBackend,
+        "supervisor": {"backoff_base_s": 0.004, "backoff_cap_s": 0.1,
+                       "probe_deadline_s": 0.5, "canary": True}}
+    sim.defer(0)
+    sim.at(3600, lambda: sim.blocksync_join(0))
+
+
 def _setup_blocksync_wedge(sim: Simulation) -> None:
     # node 0 joins late and catches up through the PIPELINED blocksync
     # engine whose verify backend never answers (the wedged-TPU-tunnel
@@ -180,6 +215,16 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
              "every tile to the CPU fallback",
              target_height=6, deadline_ms=120_000,
              setup=_setup_blocksync_wedge),
+    Scenario("device-flap", "late joiner's verify device stalls then "
+             "recovers; the supervisor probes it back to HEALTHY and "
+             "device dispatch resumes",
+             target_height=8, deadline_ms=120_000, quick_target=5,
+             setup=_setup_device_flap),
+    Scenario("device-corrupt", "late joiner's verify device answers "
+             "wrong verdicts; the canary lanes quarantine it and the "
+             "sync completes on the CPU fallback",
+             target_height=8, deadline_ms=120_000, quick_target=5,
+             setup=_setup_device_corrupt),
 ]}
 
 

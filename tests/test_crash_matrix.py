@@ -11,30 +11,33 @@ Fail points crossed per commit, in order:
   3 apply_block:pre-finalize   (before ABCI FinalizeBlock)
   4 apply_block:post-finalize  (app ran, response not saved)
   5 apply_block:post-save-response (before app commit/state save)
+
+Plus the blocksync pipeline's dispatch path (`pipeline:dispatch`,
+crossed once per tile submitted to the verify backend): a node killed
+mid-tile during catch-up must reboot through the store/WAL replay and
+resume WITHOUT double-applying the in-flight tile — covered by the
+in-process case below (which needs no network stack, so it runs even
+where the process-level e2e suite skips for lack of `cryptography`).
 """
 
 import pytest
-
-# the real TCP stack rides SecretConnection (X25519/ChaCha20);
-# containers without the cryptography wheel skip these — the
-# in-process cluster and simnet suites cover the same protocol
-# logic over crypto-free transports
-pytest.importorskip("cryptography")
-
-
-import time
-
-
-from cometbft_tpu.e2e.runner import Manifest, Testnet
-
-MANIFEST = Manifest(chain_id="crash-net", validators=4,
-                    timeout_commit_ms=50)
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize("fail_index", [0, 1, 2, 3, 4, 5])
 def test_kill_at_fail_point_then_recover(tmp_path, fail_index):
-    net = Testnet(MANIFEST, str(tmp_path / "net"))
+    # the real TCP stack rides SecretConnection (X25519/ChaCha20);
+    # containers without the cryptography wheel skip these — the
+    # in-process cluster and simnet suites cover the same protocol
+    # logic over crypto-free transports
+    pytest.importorskip("cryptography")
+    import time
+
+    from cometbft_tpu.e2e.runner import Manifest, Testnet
+
+    manifest = Manifest(chain_id="crash-net", validators=4,
+                        timeout_commit_ms=50)
+    net = Testnet(manifest, str(tmp_path / "net"))
     net.setup()
     victim = net.nodes[3]
     for node in net.nodes[:3]:
@@ -62,3 +65,99 @@ def test_kill_at_fail_point_then_recover(tmp_path, fail_index):
         net.check_no_fork(2)
     finally:
         net.stop()
+
+
+# --- crash mid-tile in the pipeline dispatch path ----------------------------
+
+class _Killed(Exception):
+    """Stands in for the process dying at the fail point (the simnet
+    SimCrash posture: unwind the stack, keep the durable stores)."""
+
+
+def test_pipeline_crash_mid_dispatch_resumes_without_double_apply():
+    """Kill the catch-up at the 3rd crossing of `pipeline:dispatch` —
+    tile 1 is applied and PERSISTED, tile 2 is in flight, tile 3 is
+    being dispatched. 'Reboot' rebuilds the volatile half exactly like
+    a real process restart (fresh app, handshake-replay of stored
+    blocks the app never saw — node/node.py _handshake) and resumes
+    from the persisted state: every height applies exactly once and
+    the final app state equals an uninterrupted run's."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.application import RequestFinalizeBlock
+    from cometbft_tpu.db.kv import MemDB
+    from cometbft_tpu.engine.blocksync import BlocksyncReactor
+    from cometbft_tpu.engine.chain_gen import (LocalChainSource,
+                                               generate_chain)
+    from cometbft_tpu.libs import fail as libfail
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State, StateStore
+    from cometbft_tpu.store.blockstore import BlockStore
+
+    chain = generate_chain(n_blocks=12, n_validators=4, txs_per_block=2)
+
+    def fresh_engine(db, app):
+        store = BlockStore(db)
+        sstore = StateStore(db)
+        executor = BlockExecutor(app, state_store=sstore,
+                                 block_store=store)
+        reactor = BlocksyncReactor(
+            executor, store, LocalChainSource(chain), chain.chain_id,
+            tile_size=4, batch_size=64, pipeline_depth=2)
+        return reactor, store, sstore
+
+    # reference: one uninterrupted pipelined run
+    ref_app = KVStoreApplication()
+    ref_app.init_chain(chain.chain_id, 1, [], b"")
+    ref_reactor, _rs, _rss = fresh_engine(MemDB(), ref_app)
+    ref_state = ref_reactor.sync(State.from_genesis(chain.genesis))
+    assert ref_state.last_block_height == 12
+
+    # crashing run: durable stores survive, the app's memory does not
+    db = MemDB()
+    app = KVStoreApplication()
+    app.init_chain(chain.chain_id, 1, [], b"")
+    reactor, store, sstore = fresh_engine(db, app)
+    crossings = {"n": 0}
+
+    def hook(label):
+        if label == "pipeline:dispatch":
+            crossings["n"] += 1
+            if crossings["n"] == 3:
+                raise _Killed(label)
+
+    libfail.set_fail_hook(hook)
+    try:
+        with pytest.raises(_Killed):
+            reactor.sync(State.from_genesis(chain.genesis))
+    finally:
+        libfail.clear_fail_hook()
+    applied_before = reactor.stats.blocks_applied
+    assert 0 < applied_before < 12  # died mid-sync with tiles in flight
+    persisted = sstore.load()
+    assert persisted is not None
+    assert persisted.last_block_height == applied_before
+
+    # reboot: fresh app replays stored blocks it has not seen (the
+    # ABCI-handshake path), then blocksync resumes from the persisted
+    # state — nothing before it may run again
+    app2 = KVStoreApplication()
+    app2.init_chain(chain.chain_id, 1, [], b"")
+    h = 1
+    while h <= persisted.last_block_height:
+        blk = store.load_block(h)
+        assert blk is not None  # applied ⇒ persisted (pre-apply save)
+        app2.finalize_block(RequestFinalizeBlock(
+            txs=blk.data.txs, height=h, time=blk.header.time,
+            proposer_address=blk.header.proposer_address,
+            hash=blk.hash(),
+            next_validators_hash=blk.header.next_validators_hash))
+        app2.commit()
+        h += 1
+    reactor2, _s2, sstore2 = fresh_engine(db, app2)
+    final = reactor2.sync(persisted)
+    assert final.last_block_height == 12
+    # exactly the remainder applied — the in-flight tile did NOT
+    # double-apply
+    assert reactor2.stats.blocks_applied == 12 - applied_before
+    assert final.app_hash == ref_state.app_hash
+    assert app2.state == ref_app.state

@@ -16,6 +16,17 @@ from cometbft_tpu.device.protocol import (decode_request, decode_response,
 from cometbft_tpu.device.server import DeviceServer
 
 
+@pytest.fixture(autouse=True)
+def _fresh_shared_supervisor():
+    """shared_client()/RemoteBatchVerifier consult the process-wide
+    health supervisor (device/health.py); keep its state (backoff
+    windows, quarantine) from leaking between tests/modules."""
+    from cometbft_tpu.device.health import reset_shared_supervisor
+    reset_shared_supervisor()
+    yield
+    reset_shared_supervisor()
+
+
 def _sigs(n, seed=9, msg_len=40):
     import random
     rng = random.Random(seed)
@@ -109,6 +120,28 @@ def test_oversized_message_unprocessable_falls_back(server):
         assert batch_ok and oks == [True, True]
     finally:
         client.close()
+
+
+def test_bucket_cap_grants_canary_headroom():
+    """A payload that exactly fills the bucket must still be
+    processable after health.splice_canaries appends its two lanes —
+    otherwise every full batch would bounce as UNPROCESSABLE and flap
+    the supervisor — while anything beyond the canary headroom (or an
+    oversized message) stays rejected. Predicate-level test: no kernel
+    compile, no traffic."""
+    from cometbft_tpu.device import health
+    srv = DeviceServer(bucket=8, max_msg_len=64)
+    try:
+        pubs = [b"\x01" * 32] * srv.bucket
+        msgs = [b"m" * 31] * srv.bucket
+        sigs = [b"\x02" * 64] * srv.bucket
+        d_pubs, d_msgs, _d_sigs = health.splice_canaries(pubs, msgs,
+                                                         sigs)
+        assert not srv._unprocessable(d_pubs, d_msgs)
+        assert srv._unprocessable(d_pubs + pubs[:1], d_msgs + msgs[:1])
+        assert srv._unprocessable(pubs, [b"\x01" * 65] + msgs[1:])
+    finally:
+        srv._listener.close()
 
 
 def test_dead_server_falls_back_locally(monkeypatch):

@@ -225,6 +225,8 @@ def test_remote_batch_verifier_retries_once_then_local():
     from cometbft_tpu.crypto import ref_ed25519 as ref
     from cometbft_tpu.device.client import (DeviceUnprocessable,
                                             RemoteBatchVerifier)
+    from cometbft_tpu.device.health import (DeviceSupervisor, HEALTHY,
+                                            SUSPECT)
     from cometbft_tpu.crypto.keys import Ed25519PubKey
 
     class FlakyClient:
@@ -236,35 +238,47 @@ def test_remote_batch_verifier_retries_once_then_local():
             self.calls += 1
             raise self.exc
 
+    def sup():
+        # explicit per-case supervisor: never leak SUSPECT into the
+        # process-wide shared instance from a test fixture client
+        return DeviceSupervisor(backoff_base_s=0.01, backoff_cap_s=0.1)
+
     seed = b"\x05" * 32
     pk, msg = ref.pubkey_from_seed(seed), b"hello"
     sig = ref.sign(seed, msg)
 
     # dead link: exactly one retry (shared_client may reconnect), then
-    # local
+    # local; the transport failures report to the supervisor
     flaky = FlakyClient(ConnectionError("link down"))
-    rbv = RemoteBatchVerifier(flaky)
+    s1 = sup()
+    rbv = RemoteBatchVerifier(flaky, supervisor=s1)
     rbv.add(Ed25519PubKey(pk), msg, sig)
     ok, oks = rbv.verify()
     assert ok and oks == [True]
     assert flaky.calls == 2
+    assert s1.state == SUSPECT and s1.trips == 2
 
     # a deadline miss means the server is wedged: retrying would double
     # the consensus-path stall — go local immediately
     wedged = FlakyClient(TimeoutError("wedged"))
-    rbv = RemoteBatchVerifier(wedged)
+    s2 = sup()
+    rbv = RemoteBatchVerifier(wedged, supervisor=s2)
     rbv.add(Ed25519PubKey(pk), msg, sig)
     ok, oks = rbv.verify()
     assert ok and oks == [True]
     assert wedged.calls == 1
+    assert s2.state == SUSPECT
 
-    # unprocessable batches go straight local (a retry can't shrink)
+    # unprocessable batches go straight local (a retry can't shrink) —
+    # and are NOT a health signal: the device answered coherently
     unproc = FlakyClient(DeviceUnprocessable("too big"))
-    rbv = RemoteBatchVerifier(unproc)
+    s3 = sup()
+    rbv = RemoteBatchVerifier(unproc, supervisor=s3)
     rbv.add(Ed25519PubKey(pk), msg, sig)
     ok, oks = rbv.verify()
     assert ok and oks == [True]
     assert unproc.calls == 1
+    assert s3.state == HEALTHY
 
 
 def test_device_deadline_env_override(monkeypatch):

@@ -21,8 +21,14 @@ echo "=== suite 1/2: ${#FIRST[@]} modules (a-o) ===" >&2
 python -m pytest "${FIRST[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
 echo "=== suite 2/2: ${#SECOND[@]} modules (p-z) ===" >&2
 python -m pytest "${SECOND[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
-echo "=== simnet selftest (determinism + crash recovery) ===" >&2
+echo "=== simnet selftest (determinism + crash recovery + device health) ===" >&2
 python tools/sim_run.py --selftest || rc=$?
+# device health supervisor liveness/safety sweep (quick): the flap
+# scenario must recover to device dispatch, the corrupt scenario must
+# quarantine — across a seed range, not just the selftest's seed 1
+echo "=== device-flap / device-corrupt quick sweeps ===" >&2
+python tools/sim_run.py --scenario device-flap --seeds 0..4 --quick || rc=$?
+python tools/sim_run.py --scenario device-corrupt --seeds 0..4 --quick || rc=$?
 # suite 2/2 already covers the slow-marked pipeline soak on a default
 # (unfiltered) run; this explicit step guarantees the depth sweep even
 # when the caller filtered the main suites (e.g. -m 'not slow'), so no

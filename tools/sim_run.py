@@ -105,13 +105,30 @@ def _selftest() -> int:
               f"(violations={d.violations}, restarts={d.restarts})")
         print(d.failure_line())
         return 1
-    for r in (a, c, d):
+    e = run_scenario("device-flap", 1, quick=True)
+    flap = [ln for ln in e.log_lines if "blocksync_device" in ln]
+    if not e.ok or not flap or "state=healthy" not in flap[0] \
+            or "probes=0" in flap[0]:
+        print("SELFTEST FAIL: device-flap did not probe back to "
+              f"HEALTHY ({flap or 'no device line'})")
+        print(e.failure_line())
+        return 1
+    f = run_scenario("device-corrupt", 1, quick=True)
+    corr = [ln for ln in f.log_lines if "blocksync_device" in ln]
+    if not f.ok or not corr or "state=quarantined" not in corr[0] \
+            or "quarantines=1" not in corr[0]:
+        print("SELFTEST FAIL: device-corrupt did not quarantine "
+              f"({corr or 'no device line'})")
+        print(f.failure_line())
+        return 1
+    for r in (a, c, d, e, f):
         if not r.ok:
             print(r.failure_line())
             return 1
-    print(f"SELFTEST OK: determinism + crash recovery "
-          f"({time.monotonic() - t0:.1f}s wall, "
-          f"h={a.max_height}/{c.max_height}/{d.max_height})")
+    print(f"SELFTEST OK: determinism + crash recovery + device "
+          f"flap/corrupt ({time.monotonic() - t0:.1f}s wall, "
+          f"h={a.max_height}/{c.max_height}/{d.max_height}/"
+          f"{e.max_height}/{f.max_height})")
     return 0
 
 
