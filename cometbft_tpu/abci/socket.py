@@ -374,14 +374,17 @@ class SocketClient(AppClientCodec):
         # retry the dial: under a process supervisor the app routinely
         # comes up a moment after the node (the reference socket client
         # retries the same way)
-        deadline = time.monotonic() + connect_retry_s
+        # deliberately wall clock: retries a REAL TCP connect to an
+        # external app process — under a virtual clock this loop could
+        # never time out
+        deadline = time.monotonic() + connect_retry_s  # staticcheck: allow(wallclock)
         while True:
             try:
                 self._sock = socket.create_connection((host, port),
                                                       timeout=5)
                 break
             except OSError:
-                if time.monotonic() >= deadline:
+                if time.monotonic() >= deadline:  # staticcheck: allow(wallclock)
                     raise
                 time.sleep(0.5)
         # blocking from here on: a per-call timeout would desynchronize

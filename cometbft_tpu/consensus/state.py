@@ -867,12 +867,15 @@ class ConsensusState:
             self.wal.write_sync(EndHeightMessage(height))
         fail_point("finalize:post-endheight")        # state.go:1897
 
-        _t0 = time.monotonic()
+        # deliberately wall clock: measures REAL apply_block compute
+        # for the block_processing histogram — virtual time would
+        # report 0 under simnet and hide regressions
+        _t0 = time.monotonic()  # staticcheck: allow(wallclock)
         new_state, _resp = self.executor.apply_block(
             self.state, bid, block, verified=True)
         if self.metrics is not None:
             self.metrics.block_processing.observe(
-                time.monotonic() - _t0)
+                time.monotonic() - _t0)  # staticcheck: allow(wallclock)
         self.on_commit(block, seen_commit)
         self._update_to_state(new_state)
         # schedule the NewHeight timeout: gather more precommits before

@@ -204,8 +204,11 @@ class DeviceServer:
 
 
 def _now() -> float:
+    # deliberately wall clock: the device server is a standalone
+    # process whose batch deadlines track real elapsed time; simnet
+    # never runs it in-process (stub backends stand in for it)
     import time
-    return time.monotonic()
+    return time.monotonic()  # staticcheck: allow(wallclock)
 
 
 def main(argv=None) -> int:

@@ -216,9 +216,11 @@ class Testnet:
 
     def wait_for_height(self, height: int, timeout: float = 120.0,
                         nodes: Optional[List[NodeProc]] = None) -> None:
-        deadline = time.monotonic() + timeout
+        # deliberately wall clock: polls REAL subprocesses over RPC —
+        # there is no virtual time to escape here
+        deadline = time.monotonic() + timeout  # staticcheck: allow(wallclock)
         pending = list(nodes if nodes is not None else self.nodes)
-        while pending and time.monotonic() < deadline:
+        while pending and time.monotonic() < deadline:  # staticcheck: allow(wallclock)
             still = []
             for node in pending:
                 try:

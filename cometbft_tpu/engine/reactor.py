@@ -188,12 +188,12 @@ class NetSource:
         # request_block_async + a cooperative pump (simnet's
         # _SimNetSource implements max_height that way).
         import time
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline:
+        deadline = time.monotonic() + 5  # staticcheck: allow(wallclock)
+        while time.monotonic() < deadline:  # staticcheck: allow(wallclock)
             h = self.reactor.max_peer_height()
             if h is not None:  # 0 is a real answer (fresh chain)
                 return h
-            time.sleep(0.05)
+            time.sleep(0.05)  # staticcheck: allow(reactor-sleep) — see above
         return 0
 
     def fetch(self, height: int):

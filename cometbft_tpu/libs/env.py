@@ -7,20 +7,61 @@ ValueError from deep inside the verify path would turn a typo into an
 outage. Previously this guard was copy-pasted in pipeline/watchdog.py
 and device/client.py (with subtly different blast radius — the client
 variant reset BOTH knobs when either was malformed); it lives here once
-and also serves the device-health backoff knobs.
+and also serves the device-health backoff knobs, the p2p keepalive
+windows, the Pallas tile size, and the signature-cache capacity.
+
+`tools/staticcheck`'s raw-env rule enforces the seam: a bare
+`int(os.environ.get(...))` outside this module is a lint error, so new
+knobs inherit the malformed-tolerant behavior automatically.
+
+Semantics shared by env_float/env_int:
+  * unset → default
+  * unparseable (empty, whitespace, wrong radix, "1.5" for an int) →
+    default
+  * NaN → default (a NaN knob poisons every comparison it feeds)
+  * `minimum` given and value < minimum → default (negative deadlines,
+    capacities, intervals are nonsensical; +inf stays allowed — it
+    reads as "never")
 """
 
 from __future__ import annotations
 
+import math
 import os
 
 
-def env_float(name: str, default: float) -> float:
-    """float(os.environ[name]) with `default` for unset OR malformed."""
+def env_float(name: str, default: float,
+              minimum: "float | None" = None) -> float:
+    """float(os.environ[name]) with `default` for unset, malformed,
+    NaN, or below `minimum`."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
     try:
-        return float(os.environ.get(name, default))
+        val = float(raw)
     except ValueError:
         return default
+    if math.isnan(val):
+        return default
+    if minimum is not None and val < minimum:
+        return default
+    return val
+
+
+def env_int(name: str, default: int,
+            minimum: "int | None" = None) -> int:
+    """int(os.environ[name]) with `default` for unset, malformed
+    (including float strings like "1.5"), or below `minimum`."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw.strip())
+    except ValueError:
+        return default
+    if minimum is not None and val < minimum:
+        return default
+    return val
 
 
 def env_bool(name: str, default: bool) -> bool:

@@ -23,9 +23,12 @@ from __future__ import annotations
 import os
 import threading
 
+from .env import env_int
+
 _lock = threading.Lock()
 _counter = 0
-_target = int(os.environ.get("COMETBFT_TPU_FAIL_INDEX", "-1"))
+# malformed index = disarmed (-1), not an import-time crash
+_target = env_int("COMETBFT_TPU_FAIL_INDEX", -1)
 # label-targeted variant: COMETBFT_TPU_FAIL_LABEL="wal:pre-rotate-rename:0"
 # crashes at the k-th crossing of exactly that label (for points that are
 # crossed data-dependently, e.g. WAL rotation, where a global index is

@@ -436,9 +436,11 @@ class Node:
         ss = self.config.statesync
         provider = light_provider_from_config(ss, self.genesis)
 
-        deadline = _time.monotonic() + ss.discovery_time_ms / 1000.0
+        # deliberately wall clock: waits on REAL peer snapshot offers
+        # during statesync discovery (simnet does not drive statesync)
+        deadline = _time.monotonic() + ss.discovery_time_ms / 1000.0  # staticcheck: allow(wallclock)
         state = None
-        while _time.monotonic() < deadline:
+        while _time.monotonic() < deadline:  # staticcheck: allow(wallclock)
             sources = net_snapshot_sources(self.statesync_reactor)
             if sources:
                 try:

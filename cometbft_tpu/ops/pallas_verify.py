@@ -41,9 +41,10 @@ from .field import MASK, LIMB_BITS, FOUR_P_LIMBS, bc
 # 4 coords x 16 limbs) = 4MB of table scratch, well under the ~16MB
 # VMEM budget including pt_add temporaries. Env-tunable so a VMEM
 # overflow on some chip generation degrades to a smaller tile instead
-# of a dead kernel.
-import os as _os
-TILE = int(_os.environ.get("COMETBFT_TPU_PALLAS_TILE", "512"))
+# of a dead kernel; malformed/nonpositive overrides fall back to the
+# default (libs/env.py) instead of raising at import.
+from ..libs.env import env_int
+TILE = env_int("COMETBFT_TPU_PALLAS_TILE", 512, minimum=1)
 
 A_WINDOWS = 64   # radix-16 digits of t_i = z_i * k_i (256-bit)
 R_WINDOWS = 32   # radix-16 digits of the 128-bit z_i

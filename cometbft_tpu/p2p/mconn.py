@@ -24,27 +24,28 @@ import time
 from dataclasses import dataclass, field as dc_field
 from typing import Callable, Dict, List, Optional
 
+from ..libs.env import env_float
 from ..types import proto
 
-import os as _os
-
 MAX_PACKET_PAYLOAD = 1400          # connection.go defaultMaxPacketMsgPayloadSize
-PING_INTERVAL = float(_os.environ.get(
-    "COMETBFT_TPU_P2P_PING_INTERVAL_S", "10"))
+# malformed overrides fall back to the defaults (libs/env.py) — a typo
+# in a systemd unit must not crash every node at import time
+PING_INTERVAL = env_float(
+    "COMETBFT_TPU_P2P_PING_INTERVAL_S", 10.0, minimum=0.0)
 # a peer that stops answering pings is dead/partitioned — tear the
 # connection down so the switch can ban/redial (reference
 # connection.go:78 defaultPongTimeout=45s, scaled to our 10s pings).
 # Env-overridable so e2e perturbation tests can shrink the window.
-PONG_TIMEOUT = float(_os.environ.get(
-    "COMETBFT_TPU_P2P_PONG_TIMEOUT_S", "30"))
+PONG_TIMEOUT = env_float(
+    "COMETBFT_TPU_P2P_PONG_TIMEOUT_S", 30.0, minimum=0.0)
 DEFAULT_SEND_RATE = 5_120_000      # bytes/s, connection.go:725 SendRate
 DEFAULT_RECV_RATE = 5_120_000      # connection.go:726 RecvRate
 
 # e2e latency emulation (reference test/e2e/runner/perturb.go's docker
 # tc-netem analog): every outbound packet sleeps this long first. Test
 # knob only; 0/unset in production.
-_SEND_LATENCY_S = float(_os.environ.get(
-    "COMETBFT_TPU_P2P_LATENCY_MS", "0")) / 1e3
+_SEND_LATENCY_S = env_float(
+    "COMETBFT_TPU_P2P_LATENCY_MS", 0.0, minimum=0.0) / 1e3
 _PKT_PING = 1
 _PKT_PONG = 2
 _PKT_MSG = 3

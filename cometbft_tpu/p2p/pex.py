@@ -27,10 +27,18 @@ _RESP = 2
 class AddressBook:
     """File-backed peer address book (reference pex/addrbook.go)."""
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 rng: Optional[random.Random] = None):
         self.path = path
         self._addrs: Dict[str, Tuple[str, int]] = {}
         self._lock = threading.Lock()
+        # pick() shuffles with a seeded instance, never the global RNG
+        # (simnet byte-identical logs). Standalone books derive from
+        # their path; PexReactor.attach upgrades an un-injected book to
+        # a node-key-derived seed so two nodes shuffle differently.
+        self._rng_injected = rng is not None
+        self._rng = rng if rng is not None \
+            else random.Random(f"addrbook:{path}")
         if path and os.path.exists(path):
             try:
                 with open(path) as f:
@@ -53,7 +61,7 @@ class AddressBook:
         with self._lock:
             cands = [(i, h, p) for i, (h, p) in self._addrs.items()
                      if i not in exclude]
-        random.shuffle(cands)
+        self._rng.shuffle(cands)
         return cands[:n]
 
     def entries(self) -> List[Tuple[str, str, int]]:
@@ -106,6 +114,13 @@ class PexReactor:
 
     def attach(self, switch) -> None:
         self._switch = switch
+        # upgrade a book that was not given an explicit RNG to a
+        # node-key-derived seed: deterministic per node, distinct
+        # between nodes (the path-derived default collides when every
+        # node uses an in-memory book with path=None)
+        if not self.book._rng_injected:
+            self.book._rng = random.Random(
+                b"pex-book:" + switch.priv_key.seed)
 
     def get_channels(self) -> List[ChannelDescriptor]:
         return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,

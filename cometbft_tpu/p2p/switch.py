@@ -91,13 +91,21 @@ class Switch:
     def __init__(self, priv_key: Ed25519PrivKey, network: str,
                  moniker: str = "node",
                  send_rate: int = 5_120_000,
-                 recv_rate: int = 5_120_000):
+                 recv_rate: int = 5_120_000,
+                 rng: Optional[random.Random] = None):
         self.priv_key = priv_key
         self.network = network
         self.send_rate = send_rate
         self.recv_rate = recv_rate
+        # reconnect jitter comes from a node-key-derived (or injected)
+        # instance, never the global RNG: simnet's byte-identical-log
+        # guarantee requires every random draw in the process to be a
+        # pure function of (scenario, seed, node key)
+        self._rng = rng if rng is not None \
+            else random.Random(b"p2p-switch:" + priv_key.seed)
         self._reactors: List[Reactor] = []
         self._chan_to_reactor: Dict[int, Reactor] = {}
+        # guarded-by: _lock: _peers
         self._peers: Dict[str, Peer] = {}
         self._lock = threading.RLock()
         self._moniker = moniker
@@ -194,8 +202,10 @@ class Switch:
                 except OSError:
                     pass  # counted in dial(); retried next round
             # jitter desynchronizes simultaneous re-dials between two
-            # nodes that each just closed the other's duplicate
-            self._ensure_stop.wait(1.0 + random.random())
+            # nodes that each just closed the other's duplicate (the
+            # node-key-derived seed keeps the two nodes' draws distinct
+            # AND each node's schedule deterministic)
+            self._ensure_stop.wait(1.0 + self._rng.random())
 
     # --- peer lifecycle -------------------------------------------------------
 

@@ -20,10 +20,11 @@ LRU-bounded; COMETBFT_TPU_SIGCACHE_CAPACITY overrides the default
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 from collections import OrderedDict
 from typing import Dict, Optional
+
+from ..libs.env import env_int
 
 DEFAULT_CAPACITY = 65536
 ENV_CAPACITY = "COMETBFT_TPU_SIGCACHE_CAPACITY"
@@ -41,6 +42,10 @@ def _key(pub: bytes, sign_bytes: bytes, sig: bytes) -> bytes:
 class SigCache:
     """Thread-safe LRU of verified-true signatures."""
 
+    # guarded-by: _lock: _entries, hits, misses, evictions
+    # (enforced by tools/staticcheck's guarded-by rule: any access to
+    # the attributes above outside `with self._lock` is a lint error)
+
     def __init__(self, capacity: int = DEFAULT_CAPACITY, metrics=None):
         self.capacity = capacity
         self.metrics = metrics  # libs/metrics_gen.PipelineMetrics or None
@@ -51,7 +56,8 @@ class SigCache:
         self.misses: Dict[str, int] = {}
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def seen(self, pub: bytes, sign_bytes: bytes, sig: bytes,
              path: str = "unknown") -> bool:
@@ -91,10 +97,11 @@ class SigCache:
 
     def hit_rate(self, path: Optional[str] = None) -> float:
         """Hits / (hits + misses), overall or for one intake path."""
-        if path is None:
-            h, m = sum(self.hits.values()), sum(self.misses.values())
-        else:
-            h, m = self.hits.get(path, 0), self.misses.get(path, 0)
+        with self._lock:
+            if path is None:
+                h, m = sum(self.hits.values()), sum(self.misses.values())
+            else:
+                h, m = self.hits.get(path, 0), self.misses.get(path, 0)
         return h / (h + m) if h + m else 0.0
 
     def clear(self) -> None:
@@ -117,11 +124,7 @@ def shared_cache() -> SigCache:
     global _shared
     with _shared_lock:
         if _shared is None:
-            try:
-                cap = int(os.environ.get(ENV_CAPACITY, DEFAULT_CAPACITY))
-            except ValueError:
-                cap = DEFAULT_CAPACITY
-            _shared = SigCache(cap)
+            _shared = SigCache(env_int(ENV_CAPACITY, DEFAULT_CAPACITY))
         return _shared
 
 

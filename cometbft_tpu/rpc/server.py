@@ -510,8 +510,11 @@ class Routes:
         if r["code"] != 0:
             return {"check_tx": r, "hash": r["hash"]}
         want = bytes.fromhex(r["hash"])
-        deadline = _time.monotonic() + 30.0
-        while _time.monotonic() < deadline:
+        # deliberately wall clock: sleep-polls the indexer from an RPC
+        # worker thread — a virtual clock cannot advance a poll loop
+        # (same hazard as engine/reactor.max_height)
+        deadline = _time.monotonic() + 30.0  # staticcheck: allow(wallclock)
+        while _time.monotonic() < deadline:  # staticcheck: allow(wallclock)
             got = self.env.tx_indexer.get(want)
             if got is not None:
                 height, _index, _raw, code = got

@@ -1,0 +1,133 @@
+"""libs/env.py — tolerant env-knob parsing edge cases.
+
+Every tunable subsystem (p2p keepalive, device deadlines, health
+backoff, Pallas tile, sig-cache capacity) reads knobs through these
+helpers; a regression here turns an operator typo into a boot abort.
+"""
+
+import math
+
+import pytest
+
+from cometbft_tpu.libs.env import env_bool, env_float, env_int
+
+K = "COMETBFT_TPU_TEST_KNOB"
+
+
+# --- env_float ------------------------------------------------------------
+
+def test_float_unset_returns_default(monkeypatch):
+    monkeypatch.delenv(K, raising=False)
+    assert env_float(K, 2.5) == 2.5
+
+
+def test_float_parses_value(monkeypatch):
+    monkeypatch.setenv(K, "3.25")
+    assert env_float(K, 1.0) == 3.25
+
+
+def test_float_whitespace_tolerated(monkeypatch):
+    monkeypatch.setenv(K, "  1.5  ")
+    assert env_float(K, 9.0) == 1.5
+
+
+@pytest.mark.parametrize("raw", ["", "   ", "abc", "1.5x", "--3"])
+def test_float_malformed_falls_back(monkeypatch, raw):
+    monkeypatch.setenv(K, raw)
+    assert env_float(K, 7.0) == 7.0
+
+
+def test_float_nan_is_malformed(monkeypatch):
+    # a NaN knob poisons every deadline comparison it feeds
+    monkeypatch.setenv(K, "nan")
+    assert env_float(K, 4.0) == 4.0
+
+
+def test_float_inf_allowed(monkeypatch):
+    # +inf reads as "never" for a deadline; only NaN is rejected
+    monkeypatch.setenv(K, "inf")
+    assert math.isinf(env_float(K, 1.0, minimum=0.0))
+
+
+def test_float_below_minimum_falls_back(monkeypatch):
+    monkeypatch.setenv(K, "-3.0")
+    assert env_float(K, 5.0, minimum=0.0) == 5.0
+    monkeypatch.setenv(K, "-inf")
+    assert env_float(K, 5.0, minimum=0.0) == 5.0
+
+
+def test_float_at_minimum_passes(monkeypatch):
+    monkeypatch.setenv(K, "0")
+    assert env_float(K, 5.0, minimum=0.0) == 0.0
+
+
+def test_float_negative_without_minimum_passes(monkeypatch):
+    monkeypatch.setenv(K, "-1.5")
+    assert env_float(K, 5.0) == -1.5
+
+
+# --- env_int --------------------------------------------------------------
+
+def test_int_unset_returns_default(monkeypatch):
+    monkeypatch.delenv(K, raising=False)
+    assert env_int(K, 512) == 512
+
+
+def test_int_parses_value(monkeypatch):
+    monkeypatch.setenv(K, "1024")
+    assert env_int(K, 512) == 1024
+
+
+def test_int_whitespace_tolerated(monkeypatch):
+    monkeypatch.setenv(K, "  64 ")
+    assert env_int(K, 512) == 64
+
+
+@pytest.mark.parametrize("raw", ["", "  ", "1.5", "0x10", "1e3", "abc"])
+def test_int_malformed_falls_back(monkeypatch, raw):
+    # float syntax is malformed for an int knob: "1.5" lanes or a
+    # "1e3"-entry cache are not a thing, and silently truncating would
+    # hide the typo
+    monkeypatch.setenv(K, raw)
+    assert env_int(K, 512) == 512
+
+
+def test_int_below_minimum_falls_back(monkeypatch):
+    # negative where nonsensical: a -1 tile size / capacity
+    monkeypatch.setenv(K, "-1")
+    assert env_int(K, 512, minimum=1) == 512
+    monkeypatch.setenv(K, "0")
+    assert env_int(K, 512, minimum=1) == 512
+
+
+def test_int_negative_without_minimum_passes(monkeypatch):
+    # libs/fail.py uses -1 as "disarmed" — a raw negative must survive
+    monkeypatch.setenv(K, "-1")
+    assert env_int(K, 0) == -1
+
+
+# --- env_bool -------------------------------------------------------------
+
+@pytest.mark.parametrize("raw", ["1", "true", "YES", "On", " true "])
+def test_bool_truthy(monkeypatch, raw):
+    monkeypatch.setenv(K, raw)
+    assert env_bool(K, False) is True
+
+
+@pytest.mark.parametrize("raw", ["0", "false", "No", "OFF", " 0 "])
+def test_bool_falsy(monkeypatch, raw):
+    monkeypatch.setenv(K, raw)
+    assert env_bool(K, True) is False
+
+
+@pytest.mark.parametrize("raw", ["", "maybe", "2", "yep"])
+def test_bool_unrecognized_falls_back(monkeypatch, raw):
+    monkeypatch.setenv(K, raw)
+    assert env_bool(K, True) is True
+    assert env_bool(K, False) is False
+
+
+def test_bool_unset_returns_default(monkeypatch):
+    monkeypatch.delenv(K, raising=False)
+    assert env_bool(K, True) is True
+    assert env_bool(K, False) is False

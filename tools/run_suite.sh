@@ -17,6 +17,11 @@ ARGS=("$@")
 FIRST=(tests/test_[a-o]*.py)
 SECOND=(tests/test_[p-z]*.py)
 rc=0
+# project-invariant lint first: cheapest check, and a new finding (or
+# a stale baseline entry) should fail the suite before any test burns
+# compile time (docs/STATICCHECK.md; fix, pragma, or --fix-baseline)
+echo "=== staticcheck: project-invariant linter ===" >&2
+python -m tools.staticcheck || rc=$?
 echo "=== suite 1/2: ${#FIRST[@]} modules (a-o) ===" >&2
 python -m pytest "${FIRST[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
 echo "=== suite 2/2: ${#SECOND[@]} modules (p-z) ===" >&2

@@ -1,0 +1,277 @@
+"""staticcheck — AST-driven project-invariant linter (stdlib-only).
+
+The tree's correctness story leans on seams nothing enforced until now:
+simnet's byte-identical-per-seed logs assume all time flows through
+`libs/timesource.py` and all randomness through seeded `random.Random`
+instances; env knobs must ride `libs/env.py`'s malformed-tolerant
+parsers; thread-shared state relies on "guarded by `_lock`"
+conventions. This package is the Python analog of the Go side's
+`go vet` + custom vet passes: ~8 plugin rules (tools/staticcheck/
+rules.py) grounded in those seams, run as
+
+    python -m tools.staticcheck            # full tree, exit 1 on findings
+    python -m tools.staticcheck --fix-baseline
+
+Escapes, in order of preference:
+  1. fix the code (route through the seam);
+  2. an inline pragma on the offending line, or on a comment-only
+     line directly above it:
+         # staticcheck: allow(<rule>[, <rule>...])
+     with a justification comment — the explicit, reviewed decision;
+  3. a per-rule file exemption in `rules.py` (whole files that are the
+     seam's documented carve-out, e.g. p2p/mconn.py for wall-clock);
+  4. a baseline entry (tools/staticcheck/baseline.txt) — grandfathered
+     debt only. The baseline may only shrink: the checker fails on NEW
+     findings and on STALE entries alike, so any drift in either
+     direction must be committed deliberately.
+
+See docs/STATICCHECK.md for rule descriptions and how to add a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+_PRAGMA_RE = re.compile(r"#\s*staticcheck:\s*allow\(([\w\-, ]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str          # repo-root-relative, posix separators
+    line: int          # 1-based
+    message: str
+    source_line: str = ""
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used for baseline matching: rule,
+        path, and the whitespace-normalized source line survive code
+        motion above the finding."""
+        norm = " ".join(self.source_line.split())
+        return f"{self.rule}|{self.path}|{norm}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class FileCtx:
+    """Parsed view of one source file handed to every per-file rule."""
+
+    def __init__(self, root: str, path: str):
+        self.root = root
+        self.path = path  # relative posix
+        with open(os.path.join(root, path), encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        # import resolution: local alias -> top-level module it names
+        # ("time", "random", "os", "datetime"), and from-imported
+        # name -> "module.attr" ("sleep" -> "time.sleep")
+        self.module_aliases: Dict[str, str] = {}
+        self.from_imports: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.module_aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+        # pragma maps: 1-based line -> set of allowed rule names. A
+        # pragma on a CODE line covers that line only; a pragma on a
+        # comment-only line additionally covers the line below (the
+        # justification-comment-above form). Without the comment-only
+        # restriction, every same-line pragma would silently disable
+        # its rule for the next statement too.
+        self.pragmas: Dict[int, Set[str]] = {}
+        self.comment_pragmas: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                self.pragmas[i] = rules
+                if text.lstrip().startswith("#"):
+                    self.comment_pragmas[i] = rules
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=rule, path=self.path, line=line,
+                       message=message, source_line=self.line_text(line))
+
+    def suppressed(self, f: Finding) -> bool:
+        """A pragma on the finding's line, or on a COMMENT-ONLY line
+        directly above it, silences the finding. Rules must be named
+        explicitly — there is deliberately no allow-everything
+        wildcard."""
+        for allowed in (self.pragmas.get(f.line),
+                        self.comment_pragmas.get(f.line - 1)):
+            if allowed and f.rule in allowed:
+                return True
+        return False
+
+
+@dataclass
+class Result:
+    findings: List[Finding] = field(default_factory=list)   # not baselined
+    suppressed: int = 0            # pragma-silenced count
+    baselined: List[Finding] = field(default_factory=list)  # matched baseline
+    stale_baseline: List[str] = field(default_factory=list)  # unmatched entries
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+
+# --- baseline -------------------------------------------------------------
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, "tools", "staticcheck", "baseline.txt")
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """fingerprint -> justification comment ('' if none)."""
+    entries: Dict[str, str] = {}
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            fp, sep, comment = line.partition("  ## ")
+            entries[fp.strip()] = comment.strip() if sep else ""
+    return entries
+
+
+_BASELINE_HEADER = """\
+# tools/staticcheck baseline — findings grandfathered when their rule
+# landed. POLICY: this file may only shrink. The checker fails on NEW
+# findings (fix the code, or pragma with justification) and on STALE
+# entries (delete the line) alike; growing it requires an explicit
+# `python -m tools.staticcheck --fix-baseline` commit, which review
+# should treat as a fix-me-now flag. Every entry needs a trailing
+# `  ## why this is temporarily acceptable` justification.
+#
+# Format: <rule>|<path>|<normalized source line>  ## <justification>
+"""
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   old_comments: Optional[Dict[str, str]] = None) -> int:
+    """Rewrite the baseline to exactly `findings`, preserving existing
+    justification comments. Returns the entry count."""
+    old_comments = old_comments or {}
+    fps = sorted({f.fingerprint() for f in findings})
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(_BASELINE_HEADER)
+        for fp in fps:
+            comment = old_comments.get(fp, "TODO: justify or fix")
+            f.write(f"{fp}  ## {comment}\n")
+    return len(fps)
+
+
+# --- runner ---------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".claude"}
+
+
+def _iter_py_files(root: str, roots: Tuple[str, ...]) -> List[str]:
+    out: List[str] = []
+    for top in roots:
+        base = os.path.join(root, top)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, name), root)
+                    out.append(rel.replace(os.sep, "/"))
+    return sorted(set(out))
+
+
+def run_checks(root: str,
+               baseline_path: Optional[str] = None,
+               rules: Optional[list] = None,
+               tree_rules: bool = True,
+               only_paths: Optional[List[str]] = None) -> Result:
+    """Run every rule over the tree rooted at `root`.
+
+    `baseline_path=None` uses tools/staticcheck/baseline.txt under
+    `root` (absent file = empty baseline). `tree_rules=False` skips
+    whole-tree rules (fail-point registry, metrics drift) — used when
+    linting a path subset, where cross-file conclusions would be wrong.
+    `only_paths` restricts scanning to the given repo-relative files or
+    directory prefixes (posix separators) — files outside are never
+    parsed.
+    """
+    from . import rules as rules_mod
+    # fresh instances every run: tree rules accumulate per-run state
+    active = [cls() for cls in
+              (rules if rules is not None else rules_mod.ALL_RULES)]
+    if not tree_rules:
+        active = [r for r in active if not r.tree_rule]
+
+    result = Result()
+    raw: List[Tuple[Finding, Optional[FileCtx]]] = []
+    ctxs: Dict[str, FileCtx] = {}
+
+    scan_roots = tuple(sorted({top for r in active for top in r.roots}))
+    for path in _iter_py_files(root, scan_roots):
+        if only_paths is not None and not any(
+                path == p or path.startswith(p.rstrip("/") + "/")
+                for p in only_paths):
+            continue
+        applicable = [r for r in active if r.applies_to(path)]
+        if not applicable:
+            continue
+        try:
+            ctx = FileCtx(root, path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            raw.append((Finding("parse", path, getattr(e, "lineno", 1) or 1,
+                                f"unparseable: {e}"), None))
+            continue
+        ctxs[path] = ctx
+        for rule in applicable:
+            for f in rule.check(ctx):
+                raw.append((f, ctx))
+
+    for rule in active:
+        for f in rule.finalize(root):
+            raw.append((f, ctxs.get(f.path)))
+
+    baseline = load_baseline(baseline_path
+                             if baseline_path is not None
+                             else default_baseline_path(root))
+    # each baseline entry absorbs AT MOST ONE finding: a new violation
+    # whose normalized source line happens to duplicate a grandfathered
+    # one must fail, not ride the old entry. Deterministic consumption
+    # order (path, line) so reruns agree on which site is "the" old one.
+    matched: Set[str] = set()
+    ordered = sorted(raw, key=lambda t: (t[0].path, t[0].line, t[0].rule))
+    for f, ctx in ordered:
+        if ctx is not None and ctx.suppressed(f):
+            result.suppressed += 1
+            continue
+        fp = f.fingerprint()
+        if fp in baseline and fp not in matched:
+            matched.add(fp)
+            result.baselined.append(f)
+            continue
+        result.findings.append(f)
+    result.stale_baseline = sorted(set(baseline) - matched)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

@@ -1,0 +1,105 @@
+"""CLI for the project-invariant linter.
+
+    python -m tools.staticcheck                # full tree, exit 1 on findings
+    python -m tools.staticcheck --list-rules
+    python -m tools.staticcheck --fix-baseline # rewrite baseline to now
+    python -m tools.staticcheck cometbft_tpu/p2p/switch.py  # subset
+                                               # (tree rules skipped)
+
+Exit codes: 0 clean, 1 findings or stale baseline entries, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import posixpath
+import sys
+
+from . import (default_baseline_path, load_baseline, run_checks,
+               write_baseline)
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.staticcheck",
+        description="AST-driven invariant linter "
+                    "(docs/STATICCHECK.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="restrict to these files (tree rules skipped)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: parent of tools/)")
+    ap.add_argument("--fix-baseline", action="store_true",
+                    help="rewrite baseline.txt to the current finding "
+                         "set (growth is visible in review — justify "
+                         "every added entry)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.name:14s} {cls.doc}")
+        return 0
+
+    if args.paths:
+        # subset lint: per-file rules only, no baseline interaction
+        # (fingerprints of unscanned files would all read as stale).
+        # Relative args resolve against --root, NOT the cwd — running
+        # from elsewhere must not silently filter everything away.
+        wanted = []
+        for p in args.paths:
+            rel = (os.path.relpath(os.path.abspath(p), root)
+                   if os.path.isabs(p) else p)
+            # normalize ./x, a/../a/x, trailing / — the scan matches
+            # by string prefix against normalized repo-relative paths
+            rel = posixpath.normpath(rel.replace(os.sep, "/"))
+            if rel.startswith("../"):
+                print(f"path outside --root: {p}", file=sys.stderr)
+                return 2
+            if not os.path.exists(os.path.join(root, rel)):
+                print(f"no such file or directory under root: {rel}",
+                      file=sys.stderr)
+                return 2
+            wanted.append(rel)
+        res = run_checks(root, baseline_path=os.devnull,
+                         tree_rules=False, only_paths=wanted)
+        res.stale_baseline = []
+    else:
+        res = run_checks(root)
+
+    if args.fix_baseline:
+        if args.paths:
+            print("--fix-baseline requires a full-tree run",
+                  file=sys.stderr)
+            return 2
+        bl_path = default_baseline_path(root)
+        old = load_baseline(bl_path)
+        n = write_baseline(bl_path, res.findings + res.baselined, old)
+        print(f"baseline rewritten: {n} entries "
+              f"({len(res.findings)} new, {len(res.stale_baseline)} "
+              f"stale removed)")
+        return 0
+
+    for f in res.findings:
+        print(f.render())
+    for fp in res.stale_baseline:
+        print(f"stale baseline entry (finding gone — delete the "
+              f"line): {fp}")
+    n_checked = f"{len(ALL_RULES)} rules"
+    if res.ok:
+        print(f"staticcheck: clean ({n_checked}, "
+              f"{res.suppressed} pragma-allowed, "
+              f"{len(res.baselined)} baselined)")
+        return 0
+    print(f"staticcheck: {len(res.findings)} finding(s), "
+          f"{len(res.stale_baseline)} stale baseline entr(y/ies) — "
+          f"see docs/STATICCHECK.md", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,443 @@
+"""The rule set. Each rule is a class with:
+
+  name / doc      — identity + one-line rationale (docs/STATICCHECK.md)
+  roots           — path prefixes (repo-relative) the rule scans
+  exempt          — whole-file carve-outs, each justified inline here
+  check(ctx)      — yield Findings for one parsed file
+  tree_rule       — True if finalize() draws cross-file conclusions
+  finalize(root)  — yield Findings after every file was seen
+
+To add a rule: subclass Rule, implement check()/finalize(), append the
+class to ALL_RULES, document it in docs/STATICCHECK.md, and give it a
+positive + negative fixture in tests/test_staticcheck.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from . import FileCtx, Finding
+
+
+class Rule:
+    name = ""
+    doc = ""
+    roots: Tuple[str, ...] = ("cometbft_tpu",)
+    exempt: frozenset = frozenset()
+    tree_rule = False
+
+    def applies_to(self, path: str) -> bool:
+        if path in self.exempt:
+            return False
+        return any(path == top or path.startswith(top + "/")
+                   for top in self.roots)
+
+    def check(self, ctx: FileCtx) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        return ()
+
+
+def _module_of(ctx: FileCtx, node: ast.AST) -> Optional[str]:
+    """Top-level module a Name refers to, via this file's imports."""
+    if isinstance(node, ast.Name):
+        return ctx.module_aliases.get(node.id)
+    return None
+
+
+class WallClockRule(Rule):
+    """All time must flow through libs/timesource.py — a direct stdlib
+    clock read in reactor code silently escapes simnet's virtual clock
+    and breaks byte-identical-per-seed logs."""
+    name = "wallclock"
+    doc = ("wall-clock read outside libs/timesource.py — route through "
+           "timesource.monotonic()/time_ns(), or pragma a deliberate "
+           "wall-clock site (waits gated on external processes)")
+    # mconn: thread loops that must keep running during a sim hold
+    # long-lived wall-clock references BY DESIGN — the documented
+    # carve-out in libs/timesource.py's module docstring.
+    exempt = frozenset({"cometbft_tpu/libs/timesource.py",
+                        "cometbft_tpu/p2p/mconn.py"})
+
+    _TIME_FNS = {"time", "time_ns", "monotonic", "monotonic_ns",
+                 "perf_counter", "perf_counter_ns"}
+    _DT_FNS = {"now", "utcnow", "today"}
+
+    def check(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                mod = _module_of(ctx, fn.value)
+                if mod == "time" and fn.attr in self._TIME_FNS:
+                    yield ctx.finding(
+                        self.name, node,
+                        f"time.{fn.attr}() outside libs/timesource — "
+                        f"use timesource.monotonic()/time_ns()")
+                elif fn.attr in self._DT_FNS and (
+                        mod == "datetime"
+                        or (isinstance(fn.value, ast.Attribute)
+                            and _module_of(ctx, fn.value.value)
+                            == "datetime")
+                        or (isinstance(fn.value, ast.Name)
+                            and ctx.from_imports.get(fn.value.id)
+                            == "datetime.datetime")):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"datetime .{fn.attr}() outside libs/timesource "
+                        f"— use timesource.time_ns()")
+            elif isinstance(fn, ast.Name):
+                target = ctx.from_imports.get(fn.id, "")
+                if target.startswith("time.") \
+                        and target[5:] in self._TIME_FNS:
+                    yield ctx.finding(
+                        self.name, node,
+                        f"{target}() outside libs/timesource — use "
+                        f"timesource.monotonic()/time_ns()")
+
+
+class GlobalRngRule(Rule):
+    """Every random draw must come from a seeded random.Random
+    instance; the module-global RNG is shared, unseeded process state
+    that breaks simnet's (scenario, seed) -> identical-log purity."""
+    name = "global-rng"
+    doc = ("module-level random.<fn>() call — draw from an injected / "
+           "seeded random.Random instance instead")
+    # bits.py pick_random accepts rng=None and falls back to the module
+    # for interactive use; every deterministic caller injects.
+    exempt = frozenset({"cometbft_tpu/libs/bits.py"})
+
+    _RNG_FNS = {"random", "randint", "randrange", "shuffle", "choice",
+                "choices", "sample", "uniform", "gauss", "getrandbits",
+                "randbytes", "seed", "triangular", "betavariate",
+                "expovariate", "normalvariate", "lognormvariate",
+                "vonmisesvariate", "paretovariate", "weibullvariate"}
+
+    def _is_global_random(self, ctx: FileCtx, base: ast.AST) -> bool:
+        if _module_of(ctx, base) == "random":
+            return True
+        # `(rng or random).choice(...)` — the fallback operand is still
+        # the global RNG
+        if isinstance(base, ast.BoolOp):
+            return any(_module_of(ctx, v) == "random" for v in base.values)
+        return False
+
+    def check(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr == "Random" \
+                    and _module_of(ctx, fn.value) == "random" \
+                    and not node.args:
+                # unseeded Random() draws OS entropy — deterministic
+                # for nobody; the invariant is SEEDED instances
+                yield ctx.finding(
+                    self.name, node,
+                    "unseeded random.Random() — seed it (node-key- or "
+                    "scenario-seed-derived) so draws replay")
+            elif isinstance(fn, ast.Attribute) \
+                    and fn.attr in self._RNG_FNS \
+                    and self._is_global_random(ctx, fn.value):
+                yield ctx.finding(
+                    self.name, node,
+                    f"global random.{fn.attr}() — use a seeded "
+                    f"random.Random instance (node-key- or "
+                    f"scenario-seed-derived)")
+            elif isinstance(fn, ast.Name):
+                target = ctx.from_imports.get(fn.id, "")
+                if target == "random.Random" and not node.args:
+                    yield ctx.finding(
+                        self.name, node,
+                        "unseeded random.Random() — seed it (node-key- "
+                        "or scenario-seed-derived) so draws replay")
+                elif target.startswith("random.") \
+                        and target[7:] in self._RNG_FNS:
+                    yield ctx.finding(
+                        self.name, node,
+                        f"global {target}() — use a seeded "
+                        f"random.Random instance")
+
+
+class RawEnvRule(Rule):
+    """Numeric/boolean env knobs must ride libs/env.py so a malformed
+    override degrades to the default instead of raising at import."""
+    name = "raw-env"
+    doc = ("os.environ read wrapped in int()/float()/bool() — use "
+           "libs/env.env_int/env_float/env_bool (malformed-tolerant)")
+    exempt = frozenset({"cometbft_tpu/libs/env.py"})
+
+    _CASTS = {"int": "env_int", "float": "env_float", "bool": "env_bool"}
+
+    def _touches_environ(self, ctx: FileCtx, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("environ", "getenv") \
+                    and _module_of(ctx, sub.value) == "os":
+                return True
+            if isinstance(sub, ast.Name) \
+                    and ctx.from_imports.get(sub.id) in ("os.environ",
+                                                         "os.getenv"):
+                return True
+        return False
+
+    def check(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in self._CASTS \
+                    and any(self._touches_environ(ctx, a)
+                            for a in node.args):
+                yield ctx.finding(
+                    self.name, node,
+                    f"{fn.id}(os.environ...) raises on a malformed "
+                    f"override — use libs/env.{self._CASTS[fn.id]}()")
+
+
+class ReactorSleepRule(Rule):
+    """Blocking sleeps in reactor/pipeline/engine code stall virtual
+    time (simnet) and the event loop alike — use the ticker /
+    timesource seams or an event wait."""
+    name = "reactor-sleep"
+    doc = ("time.sleep() in consensus//pipeline//engine — use the "
+           "ticker seam, an Event wait, or the async form")
+    roots = ("cometbft_tpu/consensus", "cometbft_tpu/pipeline",
+             "cometbft_tpu/engine")
+
+    def check(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+                    and _module_of(ctx, fn.value) == "time") \
+                    or (isinstance(fn, ast.Name)
+                        and ctx.from_imports.get(fn.id) == "time.sleep"):
+                yield ctx.finding(
+                    self.name, node,
+                    "time.sleep() in reactor code — schedule on the "
+                    "ticker / wait on an Event instead")
+
+
+_GUARD_RE = re.compile(
+    r"#\s*guarded-by:\s*(\w+)\s*:\s*([A-Za-z_][A-Za-z0-9_,\s]*)")
+
+
+class GuardedByRule(Rule):
+    """Static cousin of COMETBFT_TPU_THREAD_CHECK: a class may declare
+    `# guarded-by: _lock: attr, ...` in its body; every self.<attr>
+    read or write outside a `with self._lock:` block (and outside
+    __init__, which runs before the object is shared) is then a lint
+    error."""
+    name = "guarded-by"
+    doc = ("access to a `# guarded-by: <lock>: <attrs>`-declared "
+           "attribute outside `with self.<lock>` (and outside __init__)")
+
+    def check(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _declared(self, ctx: FileCtx,
+                  cls: ast.ClassDef) -> Dict[str, str]:
+        """attr -> lock-attr, from guarded-by comments in the class
+        body's line span."""
+        attr_lock: Dict[str, str] = {}
+        end = getattr(cls, "end_lineno", cls.lineno) or cls.lineno
+        for ln in range(cls.lineno, end + 1):
+            m = _GUARD_RE.search(ctx.line_text(ln))
+            if m:
+                lock = m.group(1)
+                for attr in m.group(2).split(","):
+                    attr = attr.strip()
+                    if attr:
+                        attr_lock[attr] = lock
+        return attr_lock
+
+    def _check_class(self, ctx: FileCtx,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        attr_lock = self._declared(ctx, cls)
+        if not attr_lock:
+            return
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and item.name != "__init__":
+                yield from self._walk(ctx, item.body, attr_lock,
+                                      held=frozenset())
+
+    def _with_locks(self, node: ast.With) -> Set[str]:
+        got: Set[str] = set()
+        for item in node.items:
+            e = item.context_expr
+            if isinstance(e, ast.Attribute) \
+                    and isinstance(e.value, ast.Name) \
+                    and e.value.id == "self":
+                got.add(e.attr)
+        return got
+
+    def _walk(self, ctx: FileCtx, body, attr_lock: Dict[str, str],
+              held: frozenset) -> Iterator[Finding]:
+        for node in body:
+            yield from self._visit(ctx, node, attr_lock, held)
+
+    def _visit(self, ctx: FileCtx, node: ast.AST,
+               attr_lock: Dict[str, str],
+               held: frozenset) -> Iterator[Finding]:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held | self._with_locks(node)
+            # the with-items themselves (self._lock) are evaluated
+            # unlocked — fine, the lock attr is never a guarded attr
+            yield from self._walk(ctx, node.body, attr_lock, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure may run later, outside the lock — conservative
+            body = node.body if isinstance(node.body, list) else [node.body]
+            yield from self._walk(ctx, body, attr_lock, frozenset())
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr in attr_lock \
+                and attr_lock[node.attr] not in held:
+            yield ctx.finding(
+                self.name, node,
+                f"self.{node.attr} is declared guarded-by "
+                f"self.{attr_lock[node.attr]} but accessed outside "
+                f"`with self.{attr_lock[node.attr]}`")
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(ctx, child, attr_lock, held)
+
+
+class FailPointRule(Rule):
+    """fail_point labels are a registry: crash schedules address them
+    by name (simnet crash_at_label, COMETBFT_TPU_FAIL_LABEL), so a
+    duplicate silently splits a schedule and an undocumented label is
+    undiscoverable. Labels must be unique string literals listed in
+    docs/SIMNET.md."""
+    name = "failpoint"
+    doc = ("fail_point labels must be unique string literals "
+           "registered in docs/SIMNET.md's fail-point registry")
+    tree_rule = True
+
+    def __init__(self):
+        self._seen: Dict[str, Tuple[str, int]] = {}
+        self._dups: List[Finding] = []
+        self._sites: List[Tuple[str, Finding]] = []
+
+    def check(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_fp = (isinstance(fn, ast.Name) and fn.id == "fail_point") \
+                or (isinstance(fn, ast.Attribute)
+                    and fn.attr == "fail_point")
+            if not is_fp:
+                continue
+            if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value):
+                yield ctx.finding(
+                    self.name, node,
+                    "fail_point label must be a non-empty string "
+                    "literal (crash schedules address it by name)")
+                continue
+            label = node.args[0].value
+            f = ctx.finding(self.name, node, "")
+            if label in self._seen:
+                first = self._seen[label]
+                self._dups.append(Finding(
+                    self.name, f.path, f.line,
+                    f"duplicate fail_point label {label!r} (first at "
+                    f"{first[0]}:{first[1]}) — crash schedules would "
+                    f"split across the sites", f.source_line))
+            else:
+                self._seen[label] = (f.path, f.line)
+                self._sites.append((label, f))
+
+    def finalize(self, root: str) -> Iterator[Finding]:
+        yield from self._dups
+        doc_path = os.path.join(root, "docs", "SIMNET.md")
+        try:
+            with open(doc_path, encoding="utf-8") as fh:
+                doc = fh.read()
+        except OSError:
+            doc = ""
+        for label, f in self._sites:
+            # exact backtick-delimited form only: a plain substring
+            # match would accept any label that happens to be a prefix
+            # of a documented one (e.g. "finalize:post" inside
+            # "finalize:post-save") or of prose
+            if f"`{label}`" not in doc:
+                yield Finding(
+                    self.name, f.path, f.line,
+                    f"fail_point label {label!r} missing from "
+                    f"docs/SIMNET.md's fail-point registry "
+                    f"(backtick-delimited exact form required)",
+                    f.source_line)
+
+
+class BareExceptRule(Rule):
+    """`except:` in the device/pipeline hot paths swallows
+    KeyboardInterrupt/SystemExit and masks wedge signatures the
+    watchdog and supervisor key off — name the exceptions."""
+    name = "bare-except"
+    doc = ("bare `except:` in device/ or pipeline/ — catch named "
+           "exception types so wedge/corruption signals propagate")
+    roots = ("cometbft_tpu/device", "cometbft_tpu/pipeline")
+
+    def check(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield ctx.finding(
+                    self.name, node,
+                    "bare `except:` — name the exception types "
+                    "(BaseException swallowing hides wedge signals)")
+
+
+class MetricsDriftRule(Rule):
+    """libs/metrics_gen.py is generated from libs/metrics_defs.py;
+    hand-edits or un-regenerated spec changes drift the Prometheus
+    surface from its declared source of truth."""
+    name = "metrics-drift"
+    doc = ("libs/metrics_gen.py must be byte-equal to regenerating "
+           "from libs/metrics_defs.py (python tools/metricsgen.py)")
+    roots: Tuple[str, ...] = ()
+    tree_rule = True
+
+    def finalize(self, root: str) -> Iterator[Finding]:
+        gen = os.path.join(root, "cometbft_tpu", "libs", "metrics_gen.py")
+        script = os.path.join(root, "tools", "metricsgen.py")
+        if not (os.path.exists(gen) and os.path.exists(script)):
+            return
+        try:
+            proc = subprocess.run(
+                [sys.executable, script, "--check"], cwd=root,
+                capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            yield Finding(self.name, "cometbft_tpu/libs/metrics_gen.py",
+                          1, f"metricsgen --check could not run: {e}")
+            return
+        if proc.returncode != 0:
+            detail = (proc.stdout + proc.stderr).strip().splitlines()
+            tail = detail[-1] if detail else "out of date"
+            yield Finding(
+                self.name, "cometbft_tpu/libs/metrics_gen.py", 1,
+                f"metrics_gen.py drifted from metrics_defs.py "
+                f"({tail}) — run: python tools/metricsgen.py")
+
+
+ALL_RULES = [WallClockRule, GlobalRngRule, RawEnvRule, ReactorSleepRule,
+             GuardedByRule, FailPointRule, BareExceptRule,
+             MetricsDriftRule]
