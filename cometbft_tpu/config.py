@@ -102,6 +102,11 @@ class RPCConfig:
     # TLS: both set -> serve https (reference TLSCertFile/TLSKeyFile)
     tls_cert_file: str = ""
     tls_key_file: str = ""
+    # mount the light-client verification farm routes
+    # (light_subscribe / light_verify / light_status — docs/FARM.md):
+    # the node then serves verification as a product, coalescing many
+    # clients' checks into shared device batches
+    light_farm: bool = False
 
     def validate_basic(self) -> None:
         """reference config.go RPCConfig.ValidateBasic."""

@@ -1,0 +1,42 @@
+"""farm/ — light-client verification farm.
+
+Serves verification *as the product* (ROADMAP item 4): many thin
+clients outsource their skipping-verification checks to one service,
+which coalesces the pending VerifyCommitLight /
+VerifyCommitLightTrusting work across ALL sessions into shared device
+batches. The shape is PAPERS.md's verification-outsourcing line — 2G2T
+constant-size MSM outsourcing (arXiv 2602.23464) and TS-Verkle's
+on-chain verifier (arXiv 2605.08682) both centralize many clients'
+checks on one prover/verifier — applied to CometBFT light clients on
+the batch-shaped commit-verify kernel PRs 2-3 built.
+
+Pieces:
+
+  session.py   per-client trust state: a LightStore-backed session
+               pinned at subscribe time, bounded by a shed limit
+  planner.py   each client's bisection schedule (the light/verifier.py
+               adjacent / non-adjacent rules) expanded HOST-SIDE into
+               signature-lane work items — threshold tallies never
+               need the device, so bisection decisions cost no round
+               trips
+  batcher.py   coalesces pending lanes across every session into one
+               shared batch: SigCache + intra-batch dedup, dispatch
+               through the DeviceClient.submit() seam with canary
+               lanes and supervisor-driven CPU fallback, bounded
+               queue with an explicit shed path
+  service.py   VerificationFarm: subscribe / verify / status, the
+               object rpc/server.py's light_* endpoints call
+
+The spec/LightClient.tla acceptance rules are the oracle: every
+accepted header's decision record is checkable by
+tools/check_light_spec.check_decisions, and the `light-farm` simnet
+scenario does exactly that for hundreds of virtual clients per seed.
+"""
+
+from .service import (FarmError, FarmOverloaded, UnknownSession,
+                      VerificationFarm, VerifyRejected)
+from .session import FarmSession, SessionManager
+
+__all__ = ["VerificationFarm", "FarmError", "FarmOverloaded",
+           "UnknownSession", "VerifyRejected", "FarmSession",
+           "SessionManager"]

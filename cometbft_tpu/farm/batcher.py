@@ -1,0 +1,265 @@
+"""Cross-session lane coalescing: many clients' pending checks become
+one shared device batch.
+
+The batcher owns a bounded pending queue of PlannedChecks. A flush
+snapshots everything pending, dedups identical (pub, msg, sig) lanes
+ACROSS checks — two clients verifying the same header pay for each
+signature once — and dispatches the unique lanes through the
+`DeviceClient.submit()` seam with the PR-3 protections intact: canary
+lanes spliced per batch, a canary mismatch quarantines the device via
+the shared supervisor, and transport failures degrade to the native
+CPU per-signature path (never the XLA kernel — a farm flush must not
+pay a multi-minute CPU jit, docs/PERF.md "known compile hazard").
+
+Backpressure is explicit: `submit()` raises QueueFull once the pending
+queue holds `max_pending_lanes` — the RPC layer turns that into a
+retryable shed error instead of letting an open-ended client crowd
+queue unbounded work. Verified-TRUE lanes land in the SigCache, so the
+NEXT client at a nearby trusted height hits cache instead of lanes.
+
+Flushing is cooperative (no background thread): callers block on their
+ticket with a small coalescing window, and whichever caller wakes
+first flushes everything pending — concurrent RPC threads coalesce,
+while single-threaded drivers (the light-farm simnet scenario, the
+bench) submit a whole wave and flush once, deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..libs.env import env_float, env_int
+from ..libs.fail import fail_point
+from ..pipeline.cache import SigCache
+from ..types.validation import ErrWrongSignature
+from .planner import Lane, PlannedCheck
+
+ENV_MAX_PENDING_LANES = "COMETBFT_TPU_FARM_MAX_PENDING_LANES"
+ENV_COALESCE_WINDOW = "COMETBFT_TPU_FARM_COALESCE_WINDOW"
+DEFAULT_MAX_PENDING_LANES = 16_384
+DEFAULT_COALESCE_WINDOW_S = 0.002
+# a wedged flush must surface, not hang an RPC worker forever; the
+# device seam's own deadline (device/client.deadline_for) is far below
+FLUSH_WAIT_S = 120.0
+
+ED25519 = "ed25519"
+
+
+class QueueFull(Exception):
+    """The pending queue is at capacity — this request is shed."""
+
+
+class CheckTicket:
+    """Handle for one submitted PlannedCheck; resolved by a flush."""
+
+    def __init__(self, planned: PlannedCheck):
+        self.planned = planned
+        self.error: Optional[Exception] = None
+        self._ev = threading.Event()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def ok(self) -> bool:
+        return self.done() and self.error is None
+
+
+def _native_verify(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
+    """CPU fallback: per-signature native verify (~50µs/sig via the C
+    fast path) — the same clamp blocksync applies on CPU nodes; never
+    the JAX kernel (compile hazard)."""
+    return [lane.pk.verify_signature(lane.msg, lane.sig)
+            for lane in lanes], "cpu"
+
+
+def device_or_cpu_backend(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
+    """Default verify backend: the DeviceClient.submit() seam with
+    canary lanes + supervisor gating (the RemoteBatchVerifier contract,
+    restated here because the farm attributes device-vs-CPU verdicts
+    per batch), CPU per-sig otherwise."""
+    from ..device import health
+    from ..device.client import DeviceUnprocessable, shared_client
+    if any(lane.pk.type_() != ED25519 for lane in lanes):
+        return _native_verify(lanes)  # device server is ed25519-only
+    client = shared_client()
+    if client is None:
+        return _native_verify(lanes)
+    sup = health.shared_supervisor()
+    if not sup.allow_connect():
+        return _native_verify(lanes)
+    pubs = [lane.pub for lane in lanes]
+    msgs = [lane.msg for lane in lanes]
+    sigs = [lane.sig for lane in lanes]
+    canaried = sup.canary
+    if canaried:
+        pubs, msgs, sigs = health.splice_canaries(pubs, msgs, sigs)
+    try:
+        _ok, oks = client.submit(pubs, msgs, sigs).result()
+    except DeviceUnprocessable:
+        return _native_verify(lanes)
+    except (TimeoutError, ConnectionError, OSError) as e:
+        sup.report_trip(e)
+        return _native_verify(lanes)
+    if canaried:
+        ok, oks = health.check_canaries(oks, len(lanes))
+        if not ok:
+            sup.report_corruption("farm batch canary mismatch")
+            return _native_verify(lanes)
+    sup.report_success()
+    return [bool(v) for v in oks], "device"
+
+
+class FarmBatcher:
+    """Bounded, coalescing, deduplicating verify queue."""
+
+    # guarded-by: _lock: _tickets, _pending_lanes
+
+    def __init__(self, cache: Optional[SigCache] = None,
+                 max_pending_lanes: Optional[int] = None,
+                 coalesce_window_s: Optional[float] = None,
+                 verify_backend: Optional[Callable] = None,
+                 metrics=None):
+        if max_pending_lanes is None:
+            max_pending_lanes = env_int(ENV_MAX_PENDING_LANES,
+                                        DEFAULT_MAX_PENDING_LANES,
+                                        minimum=1)
+        if coalesce_window_s is None:
+            coalesce_window_s = env_float(ENV_COALESCE_WINDOW,
+                                          DEFAULT_COALESCE_WINDOW_S,
+                                          minimum=0.0)
+        self.max_pending_lanes = max_pending_lanes
+        self.coalesce_window_s = coalesce_window_s
+        self.cache = cache if cache is not None else SigCache(0)
+        self.metrics = metrics  # libs/metrics_gen.FarmMetrics or None
+        self._backend = verify_backend or device_or_cpu_backend
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self._tickets: List[CheckTicket] = []
+        self._pending_lanes = 0
+        # stats (monotonic counters; light_status surfaces them)
+        self.batches = 0
+        self.lanes_by_backend: Dict[str, int] = {}
+        self.dedup_batch_hits = 0
+        self.shed = 0
+        self.last_batch_width = 0
+        self.max_batch_width = 0
+
+    # --- intake -----------------------------------------------------------
+
+    def submit(self, planned: PlannedCheck) -> CheckTicket:
+        """Queue one check; QueueFull once the lane budget is spent.
+        A check with no pending lanes (all cache hits) resolves
+        immediately — the dedup fast path costs no queue space."""
+        ticket = CheckTicket(planned)
+        if not planned.lanes:
+            ticket._ev.set()
+            return ticket
+        with self._lock:
+            if self._pending_lanes + len(planned.lanes) \
+                    > self.max_pending_lanes:
+                self.shed += 1
+                if self.metrics is not None:
+                    self.metrics.shed.inc()
+                raise QueueFull(
+                    f"farm verify queue full "
+                    f"({self._pending_lanes} lanes pending)")
+            self._tickets.append(ticket)
+            self._pending_lanes += len(planned.lanes)
+        return ticket
+
+    def cancel(self, tickets: Sequence[CheckTicket]) -> None:
+        """Withdraw not-yet-flushed tickets. A request that sheds
+        mid-plan MUST release the lane budget its earlier checks
+        claimed: nothing on the RPC path flushes a shed request's
+        orphans, so without this the bounded queue fills with dead
+        lanes and the farm sheds every later request while idle."""
+        with self._lock:
+            for ticket in tickets:
+                try:
+                    self._tickets.remove(ticket)
+                except ValueError:
+                    continue  # already snapshotted by a flush
+                self._pending_lanes -= len(ticket.planned.lanes)
+
+    def wait(self, tickets: Sequence[CheckTicket]) -> None:
+        """Block until every ticket resolves, coalescing with other
+        submitters: wait one window for someone else's flush, then
+        flush whatever is pending ourselves."""
+        for ticket in tickets:
+            if ticket._ev.wait(self.coalesce_window_s):
+                continue
+            self.flush()
+            if not ticket._ev.wait(FLUSH_WAIT_S):
+                raise RuntimeError("farm flush did not resolve ticket")
+
+    # --- the shared batch -------------------------------------------------
+
+    def flush(self) -> int:
+        """Verify everything pending in ONE coalesced batch; returns
+        the unique-lane width dispatched. Serialized: a concurrent
+        flush waits, then sees an empty queue and returns 0."""
+        with self._flush_lock:
+            with self._lock:
+                tickets, self._tickets = self._tickets, []
+                self._pending_lanes = 0
+            if not tickets:
+                return 0
+            fail_point("farm:flush")
+            try:
+                return self._run_batch(tickets)
+            except Exception as e:  # noqa: BLE001 — a backend bug must
+                # fail the waiting RPC threads, never strand them
+                for ticket in tickets:
+                    ticket.error = e
+                    ticket._ev.set()
+                raise
+
+    def _run_batch(self, tickets: List[CheckTicket]) -> int:
+        # intra-batch dedup: one device lane per unique signature, with
+        # every (ticket, lane) that needs its verdict fanned back out
+        unique: List[Lane] = []
+        index: Dict[bytes, int] = {}
+        owners: List[List[Tuple[CheckTicket, Lane]]] = []
+        for ticket in tickets:
+            for lane in ticket.planned.lanes:
+                key = self.cache.key(lane.pub, lane.msg, lane.sig)
+                at = index.get(key)
+                if at is None:
+                    index[key] = len(unique)
+                    unique.append(lane)
+                    owners.append([(ticket, lane)])
+                else:
+                    self.dedup_batch_hits += 1
+                    if self.metrics is not None:
+                        self.metrics.dedup_hits.inc(kind="batch")
+                    owners[at].append((ticket, lane))
+        oks, backend = self._backend(unique)
+        if len(oks) != len(unique):
+            raise RuntimeError(
+                f"verify backend answered {len(oks)} lanes "
+                f"for {len(unique)}")
+        self.batches += 1
+        self.last_batch_width = len(unique)
+        self.max_batch_width = max(self.max_batch_width, len(unique))
+        self.lanes_by_backend[backend] = (
+            self.lanes_by_backend.get(backend, 0) + len(unique))
+        if self.metrics is not None:
+            self.metrics.batches.inc()
+            self.metrics.batch_width.set(len(unique))
+            self.metrics.lanes.inc(len(unique), backend=backend)
+        failures: Dict[int, int] = {}  # ticket id -> first bad sig idx
+        for at, ok in enumerate(oks):
+            lane = unique[at]
+            if ok:
+                self.cache.add(lane.pub, lane.msg, lane.sig)
+                continue
+            for ticket, owner_lane in owners[at]:
+                failures.setdefault(id(ticket), owner_lane.sig_index)
+        for ticket in tickets:
+            bad = failures.get(id(ticket))
+            if bad is not None:
+                ticket.error = ErrWrongSignature(
+                    bad, ticket.planned.commit.signatures[bad].signature)
+            ticket._ev.set()
+        return len(unique)

@@ -65,6 +65,31 @@ METRICS_SPEC = {
         ("counter", "canary_failures", "device_canary_failures",
          "Device batches whose canary lanes answered wrong", ()),
     ],
+    # farm/ — the light-client verification farm (farm/service.py,
+    # batcher.py, session.py): many clients' skipping checks coalesced
+    # into shared device batches
+    "FarmMetrics": [
+        ("gauge", "sessions", "farm_sessions",
+         "Active light-client sessions", ()),
+        ("counter", "headers_accepted", "farm_headers_accepted",
+         "Headers accepted into session trust stores", ()),
+        ("counter", "headers_rejected", "farm_headers_rejected",
+         "Verify/subscribe requests rejected by the acceptance rules",
+         ()),
+        ("counter", "batches", "farm_batches",
+         "Coalesced verify batches flushed", ()),
+        ("gauge", "batch_width", "farm_batch_width",
+         "Unique-lane width of the most recent coalesced batch", ()),
+        ("counter", "lanes", "farm_lanes_verified",
+         "Signature lanes verified, by backend (device vs cpu)",
+         ("backend",)),
+        ("counter", "dedup_hits", "farm_dedup_hits",
+         "Lanes skipped by dedup (batch=intra-batch; SigCache hits "
+         "show under pipeline_sigcache_hits path=farm)", ("kind",)),
+        ("counter", "shed", "farm_shed_total",
+         "Requests shed by backpressure (session cap or lane queue)",
+         ()),
+    ],
     # reference mempool/metrics.go
     "MempoolMetrics": [
         ("gauge", "size", "mempool_size",

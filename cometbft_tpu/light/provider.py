@@ -9,13 +9,60 @@ what the `light/client_benchmark_test.go:24` mock provider does with its
 
 from __future__ import annotations
 
-from typing import Optional, Protocol
+import random
+import time
+from typing import Callable, Optional, Protocol, Tuple
 
+from ..libs import timesource
+from ..libs.env import env_float, env_int
 from .types import LightBlock, SignedHeader
+
+# transient-fetch retry knobs (HTTPProvider): one flaky socket must not
+# fail a whole multi-step verification — the reference http provider
+# retries with backoff the same way (light/provider/http http.go
+# maxRetryAttempts). Transient = OSError family ONLY (refused /reset /
+# timeout); an RPC-level error answer is a deterministic response and
+# retrying it would just triple every byzantine rejection.
+ENV_RETRIES = "COMETBFT_TPU_LIGHT_PROVIDER_RETRIES"
+ENV_RETRY_BASE = "COMETBFT_TPU_LIGHT_PROVIDER_RETRY_BASE"  # seconds
+DEFAULT_RETRIES = 2
+DEFAULT_RETRY_BASE_S = 0.05
+_JITTER_FRACTION = 0.25
 
 
 class ProviderError(Exception):
     pass
+
+
+def retry_transient(fn: Callable, rng: random.Random,
+                    retries: Optional[int] = None,
+                    base_s: Optional[float] = None,
+                    transient: Tuple = (OSError,),
+                    sleep: Optional[Callable[[float], None]] = None):
+    """Run `fn()` with jittered-exponential-backoff retries on
+    `transient` errors; the final failure re-raises. The jitter comes
+    from the caller's SEEDED rng (staticcheck's global-rng rule: every
+    draw must replay), and the sleep is suppressed while a virtual
+    clock is installed — under simnet a wall sleep would stall the
+    sim thread without advancing virtual time, and the retry sequence
+    must stay byte-identical per seed."""
+    if retries is None:
+        retries = env_int(ENV_RETRIES, DEFAULT_RETRIES, minimum=0)
+    if base_s is None:
+        base_s = env_float(ENV_RETRY_BASE, DEFAULT_RETRY_BASE_S,
+                           minimum=0.0)
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except transient:
+            if attempt == retries:
+                raise
+            delay = base_s * (2.0 ** attempt) \
+                * (1.0 + _JITTER_FRACTION * rng.random())
+            if sleep is not None:
+                sleep(delay)
+            elif not timesource.installed():
+                time.sleep(delay)
 
 
 class ErrLightBlockNotFound(ProviderError):
@@ -94,6 +141,9 @@ class HTTPProvider:
     def __init__(self, chain_id: str, rpc_client):
         self._chain_id = chain_id
         self._rpc = rpc_client
+        # deterministic backoff jitter (global-rng rule: seeded draws
+        # replay; the chain id de-phases providers without entropy)
+        self._rng = random.Random(f"light-provider:{chain_id}")
 
     def chain_id(self) -> str:
         return self._chain_id
@@ -103,15 +153,23 @@ class HTTPProvider:
         from ..rpc.codec import (commit_from_json, header_from_json,
                                  validator_set_from_json)
         try:
-            c = self._rpc.commit(height if height else None)
+            # each fetch retries transient socket failures with
+            # jittered backoff BEFORE the whole verify gives up: a
+            # bisection is many fetches, and one flaky one must not
+            # void the verified prefix
+            c = retry_transient(
+                lambda: self._rpc.commit(height if height else None),
+                self._rng)
             sh = SignedHeader(
                 header_from_json(c["signed_header"]["header"]),
                 commit_from_json(c["signed_header"]["commit"]))
             # the route is paginated (reference http provider walks
             # pages the same way); the FULL set is needed — a truncated
             # one can never match the header's validators_hash
-            vals = validator_set_from_json(
-                fetch_all_validators(self._rpc, height=sh.height))
+            vals = validator_set_from_json(retry_transient(
+                lambda: fetch_all_validators(self._rpc,
+                                             height=sh.height),
+                self._rng))
         except (RPCClientError, OSError, KeyError, ValueError) as e:
             raise ErrLightBlockNotFound(
                 f"height {height}: {e}") from e

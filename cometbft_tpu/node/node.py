@@ -272,6 +272,19 @@ class Node:
         self.switch.add_reactor(self.statesync_reactor)
 
         # --- RPC (node.go:559 — started first on OnStart) --------------------
+        # light-client verification farm ([rpc] light_farm): serves
+        # many clients' skipping checks from this node's own stores,
+        # coalesced into shared device batches (docs/FARM.md)
+        self.farm = None
+        if config.rpc.light_farm:
+            from ..farm import VerificationFarm
+            from ..libs.metrics_gen import FarmMetrics
+            from ..light.provider import BlockStoreProvider
+            self.farm = VerificationFarm(
+                self.genesis.chain_id,
+                BlockStoreProvider(self.genesis.chain_id,
+                                   self.block_store, self.state_store),
+                metrics=FarmMetrics(self.metrics_registry))
         self.rpc_env = RPCEnvironment(
             chain_id=self.genesis.chain_id,
             block_store=self.block_store,
@@ -282,7 +295,7 @@ class Node:
             app_query=self.app_conns.query, genesis=self.genesis,
             switch=self.switch,
             evidence_pool=self.evidence_pool,
-            unsafe=config.rpc.unsafe)
+            unsafe=config.rpc.unsafe, farm=self.farm)
         self.rpc_server: Optional[RPCServer] = None
         if config.rpc.enable:
             host, port = self._split_addr(config.rpc.laddr)

@@ -59,6 +59,13 @@ class SigCache:
         with self._lock:
             return len(self._entries)
 
+    @staticmethod
+    def key(pub: bytes, sign_bytes: bytes, sig: bytes) -> bytes:
+        """Stable digest of one signature triple — the cache's own
+        entry key, exposed so the farm batcher's intra-batch dedup
+        collapses identical lanes under the same identity."""
+        return _key(pub, sign_bytes, sig)
+
     def seen(self, pub: bytes, sign_bytes: bytes, sig: bytes,
              path: str = "unknown") -> bool:
         """True iff this exact signature previously verified TRUE.

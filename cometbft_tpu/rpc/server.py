@@ -37,8 +37,12 @@ class RPCEnvironment:
                  mempool=None, consensus=None, event_bus=None,
                  tx_indexer=None, block_indexer=None, app_query=None,
                  genesis=None, switch=None, state_getter=None,
-                 evidence_pool=None, unsafe=False):
+                 evidence_pool=None, unsafe=False, farm=None):
         self.chain_id = chain_id
+        # farm/service.VerificationFarm when the node serves light
+        # verification as a product; None leaves the light_* routes
+        # unmounted
+        self.farm = farm
         self.block_store = block_store
         self.state_store = state_store
         self.mempool = mempool
@@ -524,6 +528,61 @@ class Routes:
             _time.sleep(0.05)
         raise RPCError(-32603, "timed out waiting for commit")
 
+    # --- light-client verification farm (farm/service.py) ---------------------
+
+    def _farm(self):
+        if self.env.farm is None:
+            raise RPCError(-32603, "light farm not enabled")
+        return self.env.farm
+
+    @staticmethod
+    def _farm_call(fn):
+        """Map farm errors onto JSON-RPC codes: shed (-32005) is the
+        retryable overload signal, acceptance-rule rejections reuse the
+        light proxy's verification-failed code (-32001)."""
+        from ..farm import FarmOverloaded, UnknownSession, VerifyRejected
+        try:
+            return fn()
+        except FarmOverloaded as e:
+            raise RPCError(-32005, f"farm overloaded: {e}")
+        except UnknownSession as e:
+            raise RPCError(-32602, str(e))
+        except VerifyRejected as e:
+            raise RPCError(-32001, f"verification rejected: {e}")
+
+    def light_subscribe(self, height=None, hash="",
+                        trusting_period=None) -> dict:
+        """Open a session pinned at the CLIENT'S chosen trust root
+        (height + 32-byte header hash, hex) with its trusting period
+        in seconds."""
+        farm = self._farm()
+        if height is None or trusting_period is None:
+            raise RPCError(-32602, "height and trusting_period required")
+        try:
+            root_hash = bytes.fromhex(hash)
+        except ValueError:
+            raise RPCError(-32602, "hash must be hex")
+        session = self._farm_call(lambda: farm.subscribe(
+            int(height), root_hash, int(trusting_period)))
+        return session.status()
+
+    def light_verify(self, session="", height=None) -> dict:
+        """Verify the chain tip (or `height`) for a session; the
+        pending checks coalesce with every other in-flight request."""
+        farm = self._farm()
+        return self._farm_call(lambda: farm.verify(
+            str(session), int(height) if height is not None else 0))
+
+    def light_status(self, session=None) -> dict:
+        """Farm-wide counters, or one session's trust state."""
+        farm = self._farm()
+        return self._farm_call(lambda: farm.status(
+            str(session) if session is not None else None))
+
+    def light_unsubscribe(self, session="") -> dict:
+        farm = self._farm()
+        return {"dropped": farm.unsubscribe(str(session))}
+
     # --- events (long-poll stand-in for the WS subscription) ------------------
 
     def wait_event(self, query="", timeout=None) -> dict:
@@ -583,6 +642,11 @@ class RPCServer:
                 # reference routes.go:56-62: only with rpc.unsafe=true
                 names += ["dial_seeds", "dial_peers",
                           "unsafe_flush_mempool"]
+            if env is not None and env.farm is not None:
+                # verification-farm routes (docs/FARM.md) — mounted
+                # only when the node carries a farm
+                names += ["light_subscribe", "light_verify",
+                          "light_status", "light_unsubscribe"]
             methods = {name: getattr(routes, name) for name in names}
 
         class Handler(BaseHTTPRequestHandler):

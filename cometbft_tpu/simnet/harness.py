@@ -91,7 +91,11 @@ RECONCILE_MS = 500  # virtual cadence of the round-state gossip healer
 @dataclass
 class Scenario:
     """One bundled fault schedule. `setup(sim)` installs faults/taps and
-    schedules timed actions before any node starts."""
+    schedules timed actions before any node starts. A scenario with a
+    `runner` bypasses the consensus Simulation entirely: run_scenario
+    calls `runner(scenario, seed, quick=, workdir=)` and expects a
+    SimResult back (the light-farm scenario simulates a CLIENT crowd,
+    not a validator set)."""
     name: str
     description: str
     target_height: int
@@ -99,6 +103,7 @@ class Scenario:
     setup: Optional[Callable[["Simulation"], None]] = None
     n_vals: int = 4
     quick_target: int = 3
+    runner: Optional[Callable[..., "SimResult"]] = None
 
 
 @dataclass

@@ -1,0 +1,352 @@
+"""light-farm scenario: hundreds of virtual light clients outsource
+their skipping verification to one farm — deterministically.
+
+Unlike the consensus scenarios this one runs no nodes and no network:
+the simulated population is the CLIENT crowd. A seeded PRNG draws every
+client's trusted height, every request target, and which requests ride
+a tampered provider; the farm is driven single-threaded through its
+two-phase seam (begin a wave, flush ONE coalesced batch, finish the
+wave), so the whole run — batch widths, dedup counts, accept / reject /
+shed decisions — is a pure function of (scenario, seed) and the event
+log is byte-identical per seed (tests/test_simnet.py pins it, the same
+contract as every other scenario).
+
+Phases: subscribe (staggered trust roots in the chain's lower half;
+the last 4 clients hit the session cap and shed) → burst (every client
+jumps to a distinct upper-half height at once; fresh lanes overrun the
+128-lane queue, shed, and clear on flush-then-retry) → verify rounds
+(the crowd chases the tip; two seeded clients per round ride provider
+forgeries that must be rejected).
+
+Invariant probes:
+  * spec conformance — every accepted header's decision record is
+    re-judged by tools/check_light_spec.check_decisions against the
+    spec/LightClient.tla acceptance rules;
+  * agreement — every accepted header IS the canonical header of its
+    height (provider forgeries must never be accepted);
+  * forgery rejection — each tampered request (forged header hash, or
+    a flipped commit signature) is rejected host-side or by its lane
+    verdict;
+  * shed exactness — the bounded session cap and lane queue must both
+    actually fire (a scenario that never sheds pins nothing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import time as _walltime
+from dataclasses import replace
+from typing import Dict, List, Optional
+
+from ..engine.chain_gen import ChainLightProvider, generate_chain
+from ..farm import FarmOverloaded, VerificationFarm, VerifyRejected
+from ..farm.batcher import FarmBatcher
+from ..farm.session import SessionManager
+from ..light.types import LightBlock, SignedHeader
+from ..pipeline.cache import SigCache
+from ..types.block import Commit, CommitSig
+from ..types.proto import Timestamp
+from .harness import SimResult
+
+SUBSCRIBE_WAVE = 16
+
+
+def _reason(e: BaseException) -> str:
+    """Deterministic one-token rejection label for the event log."""
+    cause = e.__cause__
+    return type(cause).__name__ if cause is not None else type(e).__name__
+
+
+class TamperingProvider(ChainLightProvider):
+    """ChainLightProvider plus an armable per-height forgery: `hash`
+    serves a forged header (wrong app hash) with the ORIGINAL commit —
+    rejected host-side by validate_basic's commit/header binding; `sig`
+    serves the real header with signer 0's signature bit-flipped —
+    rejected by the coalesced batch's lane verdict."""
+
+    def __init__(self, chain):
+        super().__init__(chain)
+        self.armed: Dict[int, str] = {}
+
+    def light_block(self, height: int) -> LightBlock:
+        lb = super().light_block(height)
+        mode = self.armed.get(height if height
+                              else self.chain.max_height())
+        if mode == "hash":
+            hdr = replace(lb.signed_header.header, app_hash=b"\x66" * 32)
+            return LightBlock(SignedHeader(hdr, lb.signed_header.commit),
+                              lb.validator_set)
+        if mode == "sig":
+            c = lb.signed_header.commit
+            sigs = list(c.signatures)
+            s = sigs[0]
+            sigs[0] = CommitSig(s.block_id_flag, s.validator_address,
+                                s.timestamp,
+                                bytes([s.signature[0] ^ 1])
+                                + s.signature[1:])
+            forged = Commit(c.height, c.round, c.block_id, sigs)
+            return LightBlock(SignedHeader(lb.signed_header.header,
+                                           forged), lb.validator_set)
+        return lb
+
+
+class _FarmSim:
+    def __init__(self, scenario, seed: int, quick: bool):
+        self.name = scenario.name
+        self.seed = seed
+        if quick:
+            self.n_blocks, self.n_vals = 10, 4
+            self.n_clients, self.rounds = 60, 2
+        else:
+            self.n_blocks, self.n_vals = 20, 6
+            self.n_clients, self.rounds = 240, 3
+        self.rng = random.Random(f"simnet:{scenario.name}:{seed}")
+        self.log_lines: List[str] = []
+        self.violations: List[str] = []
+        self.accepted = 0
+        self.rejected = 0
+        self.shed = 0
+
+    def log(self, kind: str, **kw) -> None:
+        fields = " ".join(f"{k}={v}" for k, v in kw.items())
+        self.log_lines.append(f"{kind} {fields}".rstrip())
+
+    def violation(self, msg: str) -> None:
+        self.log("violation", msg=msg.replace(" ", "_"))
+        self.violations.append(msg)
+
+    # --- phases -----------------------------------------------------------
+
+    def run(self) -> SimResult:
+        t0 = _walltime.perf_counter()  # staticcheck: allow(wallclock)
+        chain = generate_chain(self.n_blocks, self.n_vals,
+                               seed=1 + self.seed % 11, txs_per_block=1)
+        self.chain = chain
+        self.provider = TamperingProvider(chain)
+        now = Timestamp(1_700_000_000 + chain.max_height() + 5, 0)
+        cache = SigCache(65536)  # fresh per run: byte-identical logs
+        # bounded on purpose: the last 4 subscribes hit the session
+        # cap, and the burst round overruns the lane queue — both shed
+        # paths fire on every seed
+        self.farm = VerificationFarm(
+            chain.chain_id, self.provider, cache=cache,
+            sessions=SessionManager(max_sessions=self.n_clients - 4),
+            batcher=FarmBatcher(cache=cache, coalesce_window_s=0.0,
+                                max_pending_lanes=128),
+            now_fn=lambda: now)
+        self.log("start", scenario=self.name, seed=self.seed,
+                 blocks=self.n_blocks, vals=self.n_vals,
+                 clients=self.n_clients)
+        self.sessions: List[Optional[str]] = []
+        self._subscribe_phase()
+        self._burst_round()
+        for r in range(1, self.rounds + 1):
+            self._verify_round(r)
+        self._final_checks()
+        st = self.farm.status()
+        self.log("end", accepted=self.accepted, rejected=self.rejected,
+                 shed=self.shed, batches=st["batches"],
+                 max_width=st["max_batch_width"],
+                 dedup_batch=st["dedup_batch_hits"],
+                 cache_rate=st["cache_hit_rate"],
+                 violations=len(self.violations))
+        digest = hashlib.sha256()
+        for line in self.log_lines:
+            digest.update(line.encode())
+            digest.update(b"\n")
+        return SimResult(
+            scenario=self.name, seed=self.seed,
+            violations=self.violations,
+            max_height=chain.max_height(), heights={},
+            app_hashes={}, log_lines=self.log_lines,
+            digest=digest.hexdigest(),
+            # staticcheck: allow(wallclock) — wall_s never enters the log
+            wall_s=_walltime.perf_counter() - t0,
+            virtual_s=0.0, commits_per_sim_s=0.0,
+            crashes=0, restarts=0, evidence_seen=0, errors=[],
+            stats={"delivered": self.accepted, "dropped": self.rejected,
+                   "blocked": self.shed, "events": st["batches"]})
+
+    def _subscribe_phase(self) -> None:
+        """Staggered trust roots in the LOWER half of the chain (the
+        burst round then has uncached upper-half commits to chew on),
+        subscribed in coalesced waves; the clients past the session
+        cap must shed."""
+        chain = self.chain
+        lo, hi = 1, max(2, chain.max_height() // 2)
+        pending = []
+        for i in range(self.n_clients):
+            h0 = self.rng.randrange(lo, hi + 1)
+            try:
+                p = self.farm.begin_subscribe(
+                    h0, chain.blocks[h0 - 1].hash(), 10 ** 9)
+            except FarmOverloaded:
+                self.shed += 1
+                self.sessions.append(None)
+                self.log("shed", client=i, phase="subscribe", h=h0)
+                continue
+            pending.append((i, h0, p))
+            self.sessions.append("pending")
+            if len(pending) == SUBSCRIBE_WAVE:
+                self._finish_subscribes(pending)
+                pending = []
+        self._finish_subscribes(pending)
+
+    def _finish_subscribes(self, pending) -> None:
+        if not pending:
+            return
+        width = self.farm.batcher.flush()
+        self.log("flush", phase="subscribe", width=width)
+        for i, h0, p in pending:
+            session = self.farm.finish_subscribe(p)
+            self.sessions[i] = session.session_id
+            self.log("subscribe", client=i, session=session.session_id,
+                     h=h0)
+
+    def _burst_round(self) -> None:
+        """Every client jumps to a (mostly distinct) upper-half height
+        at once: fresh lanes overflow the 128-lane queue, the
+        overflowing requests shed, and a flush-then-retry clears them
+        — the documented backpressure contract."""
+        chain = self.chain
+        live = [(i, sid) for i, sid in enumerate(self.sessions)
+                if sid is not None]
+        lo = chain.max_height() // 2 + 1
+        heights = list(range(lo, chain.max_height() + 1))
+        wave = []
+        for i, sid in live:
+            h = heights[(i * 7 + self.seed) % len(heights)]
+            try:
+                p = self.farm.begin_verify(sid, h)
+            except FarmOverloaded:
+                self.shed += 1
+                self.log("shed", client=i, phase="burst", h=h)
+                width = self.farm.batcher.flush()
+                self.log("flush", phase="burst", width=width)
+                try:
+                    p = self.farm.begin_verify(sid, h)  # retry once
+                except (FarmOverloaded, VerifyRejected) as e:
+                    self.rejected += 1
+                    self.log("reject", client=i, phase="burst",
+                             reason=_reason(e))
+                    continue
+            except VerifyRejected as e:
+                self.rejected += 1
+                self.log("reject", client=i, phase="burst",
+                         reason=_reason(e))
+                continue
+            wave.append((i, p))
+        width = self.farm.batcher.flush()
+        self.log("flush", phase="burst", width=width)
+        for i, p in wave:
+            try:
+                out = self.farm.finish_verify(p)
+            except VerifyRejected as e:
+                self.rejected += 1
+                self.log("reject", client=i, phase="burst",
+                         reason=_reason(e))
+                continue
+            self.accepted += 1
+            self.log("accept", client=i, phase="burst", h=out["height"],
+                     b=out["hash"][:16], steps=out["steps"])
+
+    def _verify_round(self, r: int) -> None:
+        """One tip-chasing wave; two seeded clients ride the tampered
+        provider and must be rejected."""
+        chain = self.chain
+        live = [(i, sid) for i, sid in enumerate(self.sessions)
+                if sid is not None]
+        # two DISTINCT clients (choice() twice could collide and
+        # silently drop the hash-forgery case for the round)
+        picks = self.rng.sample(live, 2)
+        tampered = {picks[0][0]: "hash", picks[1][0]: "sig"}
+        wave = []
+        for i, sid in live:
+            if i in tampered:
+                continue
+            if (i + r) % 7 == 0:
+                continue  # this client sits the round out
+            try:
+                p = self.farm.begin_verify(sid, chain.max_height())
+            except VerifyRejected as e:
+                self.rejected += 1
+                self.log("reject", client=i, round=r, reason=_reason(e))
+                continue
+            except FarmOverloaded:
+                self.shed += 1
+                self.log("shed", client=i, round=r, phase="verify")
+                continue
+            wave.append((i, p))
+        width = self.farm.batcher.flush()
+        self.log("flush", phase="verify", round=r, width=width)
+        for i, p in wave:
+            try:
+                out = self.farm.finish_verify(p)
+            except VerifyRejected as e:
+                self.rejected += 1
+                self.log("reject", client=i, round=r, reason=_reason(e))
+                continue
+            self.accepted += 1
+            self.log("accept", client=i, round=r, h=out["height"],
+                     b=out["hash"][:16], steps=out["steps"])
+        for i, mode in sorted(tampered.items()):
+            self._tampered_request(i, r, mode)
+
+    def _tampered_request(self, i: int, r: int, mode: str) -> None:
+        """One forged-provider request, armed only for this call; it
+        must be rejected (host-side or by lane verdict) — unless the
+        session ALREADY trusts the canonical tip, in which case the
+        store fast path serves the previously verified header and the
+        forgery never reaches planning."""
+        chain = self.chain
+        sid = self.sessions[i]
+        self.provider.armed = {chain.max_height(): mode}
+        try:
+            p = self.farm.begin_verify(sid, chain.max_height())
+            self.farm.batcher.flush()
+            self.farm.finish_verify(p)
+        except VerifyRejected as e:
+            self.rejected += 1
+            self.log("forged_rejected", client=i, round=r, mode=mode,
+                     reason=_reason(e))
+        except FarmOverloaded:
+            self.shed += 1
+            self.log("shed", client=i, round=r, phase="forged")
+        else:
+            if self.farm.sessions.get(sid).latest().header.hash() != \
+                    chain.blocks[-1].hash():
+                self.violation(
+                    f"forged ({mode}) header accepted for client {i}")
+            else:
+                self.log("forged_served_from_store", client=i, round=r,
+                         mode=mode)
+        finally:
+            self.provider.armed = {}
+
+    def _final_checks(self) -> None:
+        records = self.farm.drain_decisions()
+        self.log("decisions", n=len(records))
+        # the spec oracle: every acceptance re-judged against the
+        # LightClient.tla rules (tools/check_light_spec.py — repo-root
+        # import, the layout sim_run.py and pytest both guarantee)
+        from tools.check_light_spec import check_decisions
+        for err in check_decisions(records):
+            self.violation(f"spec: {err}")
+        for rec in records:
+            canonical = self.chain.blocks[rec["height"] - 1].hash().hex()
+            if rec["hash"] != canonical:
+                self.violation(
+                    f"agreement: accepted non-canonical header at "
+                    f"height {rec['height']}")
+        if self.shed == 0:
+            self.violation("shed paths never exercised (bounded "
+                           "limits were not reached)")
+
+
+def run_light_farm(scenario, seed: int, quick: bool = False,
+                   workdir=None) -> SimResult:
+    """Scenario runner (scenarios.py dispatches here; `workdir` is
+    part of the runner contract but unused — the farm sim touches no
+    files)."""
+    return _FarmSim(scenario, seed, quick).run()

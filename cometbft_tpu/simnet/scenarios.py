@@ -21,6 +21,7 @@ from ..types.block import BlockID
 from ..types.vote import Vote
 from .clock import MS
 from .harness import Scenario, Simulation
+from .light_farm import run_light_farm as _run_light_farm
 from .transport import LinkPolicy
 
 
@@ -225,6 +226,13 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
              "sync completes on the CPU fallback",
              target_height=8, deadline_ms=120_000, quick_target=5,
              setup=_setup_device_corrupt),
+    Scenario("light-farm", "hundreds of virtual light clients at "
+             "staggered trusted heights outsource verification to the "
+             "farm; forged requests reject, bounded queues shed, and "
+             "every accepted header is re-judged against the "
+             "LightClient.tla acceptance rules",
+             target_height=20, deadline_ms=0,
+             runner=_run_light_farm),
 ]}
 
 
@@ -237,6 +245,9 @@ def run_scenario(name: str, seed: int, quick: bool = False,
         raise ValueError(
             f"unknown scenario {name!r}; have: "
             f"{', '.join(sorted(SCENARIOS))}") from None
+    if scenario.runner is not None:
+        return scenario.runner(scenario, seed, quick=quick,
+                               workdir=workdir)
     return Simulation(scenario, seed, workdir=workdir, quick=quick).run()
 
 

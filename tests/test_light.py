@@ -109,6 +109,46 @@ def test_witness_divergence_detected(chain):
         lc.verify_light_block_at_height(target)
 
 
+def test_witness_provider_error_skipped(chain):
+    """Honest-majority recovery: a lagging/unreachable witness raises
+    ProviderError and is SKIPPED (reference detector retries/drops) —
+    verification succeeds on the honest primary + remaining witness,
+    and the header lands in the store."""
+    from cometbft_tpu.light.provider import ProviderError
+
+    class DownWitness(ChainProvider):
+        def light_block(self, height):
+            raise ProviderError("connection refused")
+
+    target = chain.max_height()
+    lc = _client(chain, witnesses=[DownWitness(chain),
+                                   ChainProvider(chain)])
+    lb = lc.verify_light_block_at_height(target)
+    assert lb.header.hash() == chain.blocks[target - 1].hash()
+    assert lc.trusted_light_block(target) is not None
+
+
+def test_witness_divergence_evicts_and_reports(chain):
+    """The divergent header must be EVICTED from the store (a stored
+    block short-circuits all future verification) and the constructed
+    attack evidence reported to the providers that can act on it."""
+    target = chain.max_height()
+    witness = ChainProvider(chain, tamper_height=target)
+    witness.reported = []
+    witness.report_evidence = witness.reported.append
+    lc = _client(chain, witnesses=[witness])
+    lc.primary.reported = []
+    lc.primary.report_evidence = lc.primary.reported.append
+    with pytest.raises(ConflictingHeadersError) as ei:
+        lc.verify_light_block_at_height(target)
+    assert ei.value.witness_index == 0
+    # the disputed height must not stay trusted
+    assert lc.trusted_light_block(target) is None
+    # cross-reported: the witness's header to the primary, the
+    # primary's to the witness
+    assert lc.primary.reported and witness.reported
+
+
 def test_bad_trust_root_rejected(chain):
     prov = ChainProvider(chain)
     opts = TrustOptions(period_seconds=TRUST_PERIOD, height=1,
@@ -349,6 +389,88 @@ def test_two_witness_fork_at_common_height(chain):
     verify_light_client_attack(
         ev, state, common_vals,
         chain.blocks[conflict_h - 1].header)
+
+
+def test_provider_retry_transient():
+    """retry_transient: transient OSErrors retry with jittered
+    exponential backoff (deterministic from the seeded rng) and the
+    final failure re-raises; non-transient errors never retry."""
+    import random
+
+    from cometbft_tpu.light.provider import retry_transient
+
+    calls = {"n": 0}
+    delays = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionRefusedError("refused")
+        return "ok"
+
+    rng = random.Random("t")
+    assert retry_transient(flaky, rng, retries=2, base_s=0.01,
+                           sleep=delays.append) == "ok"
+    assert calls["n"] == 3
+    assert len(delays) == 2 and delays[1] > delays[0]  # backoff grows
+
+    # exhausted retries re-raise the transient error
+    with pytest.raises(OSError):
+        retry_transient(lambda: (_ for _ in ()).throw(OSError("down")),
+                        rng, retries=1, base_s=0.0, sleep=delays.append)
+
+    # a deterministic (non-transient) error is raised immediately
+    calls["n"] = 0
+
+    def hard_fail():
+        calls["n"] += 1
+        raise ValueError("malformed")
+
+    with pytest.raises(ValueError):
+        retry_transient(hard_fail, rng, retries=3, base_s=0.0,
+                        sleep=delays.append)
+    assert calls["n"] == 1
+
+
+def test_http_provider_retries_flaky_fetch(chain):
+    """HTTPProvider.light_block survives transient socket failures on
+    the /commit fetch instead of failing the whole verify."""
+    from cometbft_tpu.light.provider import HTTPProvider
+    from cometbft_tpu.rpc.codec import (commit_json, header_json,
+                                        validator_set_json)
+
+    target = chain.max_height()
+    blk = chain.blocks[target - 1]
+
+    class FlakyRPC:
+        def __init__(self):
+            self.commit_calls = 0
+
+        def commit(self, height=None):
+            self.commit_calls += 1
+            if self.commit_calls < 3:
+                raise ConnectionResetError("flaky wire")
+            return {"signed_header": {
+                "header": header_json(blk.header),
+                "commit": commit_json(chain.seen_commits[target - 1])}}
+
+        def call(self, method, **kw):
+            assert method == "validators"
+            js = validator_set_json(chain.valsets[target - 1])
+            return {"block_height": target,
+                    "validators": js["validators"],
+                    "proposer": js["proposer"],
+                    "total": len(js["validators"])}
+
+    import os
+    os.environ["COMETBFT_TPU_LIGHT_PROVIDER_RETRY_BASE"] = "0"
+    try:
+        prov = HTTPProvider(chain.chain_id, FlakyRPC())
+        lb = prov.light_block(target)
+    finally:
+        del os.environ["COMETBFT_TPU_LIGHT_PROVIDER_RETRY_BASE"]
+    assert lb.header.hash() == blk.hash()
+    lb.validate_basic(chain.chain_id)
 
 
 def test_backwards_mismatch_rejected_and_not_stored(chain):

@@ -128,6 +128,35 @@ def test_device_corrupt_event_log_deterministic():
     assert a.log_lines == b.log_lines
 
 
+def test_light_farm_scenario():
+    """The verification-farm crowd scenario: forged requests reject,
+    both bounded-queue shed paths fire, and every accepted header
+    passed the LightClient.tla acceptance oracle (a violation would
+    fail r.ok)."""
+    r = run_scenario("light-farm", 1, quick=True)
+    assert r.ok, r.violations
+    assert r.stats["delivered"] > 50      # accepted headers
+    assert r.stats["blocked"] >= 5        # session-cap + lane sheds
+    assert any(line.startswith("forged_rejected")
+               for line in r.log_lines)
+    assert any(line.startswith("shed") and "subscribe" in line
+               for line in r.log_lines)
+    assert any(line.startswith("shed") and "burst" in line
+               for line in r.log_lines)
+
+
+def test_light_farm_determinism():
+    """Same seed => byte-identical farm event log (batch widths, dedup
+    counts, every accept/reject/shed decision)."""
+    a = run_scenario("light-farm", 4, quick=True)
+    b = run_scenario("light-farm", 4, quick=True)
+    assert a.ok, a.violations
+    assert a.digest == b.digest
+    assert a.log_lines == b.log_lines
+    c = run_scenario("light-farm", 5, quick=True)
+    assert c.digest != a.digest
+
+
 def test_seed_sweep_smoke():
     """Fast tier-1 sweep (<=20s CPU): one quick seed through each of
     the four headline fault classes. The full catalog runs in the

@@ -48,6 +48,62 @@ def subsets(universe, min_size=1):
         yield from itertools.combinations(universe, k)
 
 
+# --- the two acceptance predicates, power-weighted ---------------------------
+#
+# Shared by the exhaustive model below (equal-power valsets: power =
+# cardinality) and by `check_decisions`, which re-judges CONCRETE
+# acceptance records (the farm's decision log, where validators carry
+# real voting power). Both restate validation.py's floor-divided strict
+# thresholds: needed = total * num // den, accepted iff tallied > needed.
+
+def trusting_ok_power(signed: int, total: int,
+                      num: int = 1, den: int = 3) -> bool:
+    """verify_commit_light_trusting: trusted-set power that signed must
+    EXCEED floor(total * num/den) (validation.py:210-216, strict)."""
+    return signed > (total * num) // den
+
+
+def own_commit_ok_power(signed: int, total: int) -> bool:
+    """verify_commit_light: claimed-set power on the commit must EXCEED
+    floor(2/3 * total) (validation.py:189-194, strict)."""
+    return signed > (total * 2) // 3
+
+
+def check_decisions(records):
+    """Re-judge accepted-header decision records against the spec's
+    acceptance rules; returns violation strings (empty = all conform).
+
+    Each record states one farm/light acceptance as its power tallies
+    (farm/planner._record): `adjacent`, `valhash_bound`, `own_signed` /
+    `own_total` (the header's own claimed set on its commit), and for
+    skipping steps `trusted_signed` / `trusted_total` (trusted-set
+    power that signed) plus the trust fraction. This is the bridge the
+    light-farm simnet scenario crosses: every header the farm accepted
+    must satisfy exactly the rules the exhaustive model proves safe."""
+    errs = []
+    for i, r in enumerate(records):
+        label = (f"record {i} h={r.get('height')} "
+                 f"session={r.get('session', '?')}")
+        if not own_commit_ok_power(r["own_signed"], r["own_total"]):
+            errs.append(
+                f"{label}: own-commit power {r['own_signed']}/"
+                f"{r['own_total']} fails the >2/3 rule")
+        if r.get("adjacent"):
+            if not r.get("valhash_bound"):
+                errs.append(f"{label}: adjacent step accepted without "
+                            f"valset-hash binding")
+        elif not trusting_ok_power(r["trusted_signed"],
+                                   r["trusted_total"],
+                                   r.get("trust_num", 1),
+                                   r.get("trust_den", 3)):
+            errs.append(
+                f"{label}: trusting power {r['trusted_signed']}/"
+                f"{r['trusted_total']} fails the "
+                f">{r.get('trust_num', 1)}/{r.get('trust_den', 3)} "
+                f"rule")
+    return errs
+
+
 class LightModel:
     def __init__(self, n=4, heights=4, min_valset=3,
                  break_assumption=False):
@@ -63,14 +119,15 @@ class LightModel:
 
     @staticmethod
     def trusting_ok(signers, trusted) -> bool:
-        """validation.py:192-194 + tallied > needed (strict)."""
-        return len(signers & trusted) > len(trusted) * 1 // 3
+        """validation.py:192-194 + tallied > needed (strict); equal
+        power, so power = cardinality."""
+        return trusting_ok_power(len(signers & trusted), len(trusted))
 
     @staticmethod
     def own_commit_ok(signers, claimed) -> bool:
         """verify_commit_light: signers must be members; > 2/3."""
         return (signers <= claimed
-                and len(signers) > len(claimed) * 2 // 3)
+                and own_commit_ok_power(len(signers), len(claimed)))
 
     # --- enumeration ------------------------------------------------------
 

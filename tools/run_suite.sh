@@ -34,6 +34,13 @@ python tools/sim_run.py --selftest || rc=$?
 echo "=== device-flap / device-corrupt quick sweeps ===" >&2
 python tools/sim_run.py --scenario device-flap --seeds 0..4 --quick || rc=$?
 python tools/sim_run.py --scenario device-corrupt --seeds 0..4 --quick || rc=$?
+# light-farm smoke: the scenario sweep pins determinism + the spec
+# oracle; the bench A/B proves coalescing still beats N sequential
+# clients (tiny config — the PERF.md datum is the N=32 run)
+echo "=== light-farm quick sweep + farm A/B smoke ===" >&2
+python tools/sim_run.py --scenario light-farm --seeds 0..4 --quick || rc=$?
+python tools/bench_light.py --farm --clients 8 --blocks 12 \
+    --validators 20 --json || rc=$?
 # suite 2/2 already covers the slow-marked pipeline soak on a default
 # (unfiltered) run; this explicit step guarantees the depth sweep even
 # when the caller filtered the main suites (e.g. -m 'not slow'), so no
