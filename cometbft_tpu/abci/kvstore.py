@@ -77,7 +77,21 @@ class KVStoreApplication(BaseApplication):
 
     # --- mempool -------------------------------------------------------------
 
+    @staticmethod
+    def _unwrap(tx: bytes) -> bytes:
+        """App-visible payload: signed-envelope txs (ingest/tx.py) shed
+        their authentication header — the envelope is admission-layer
+        concern, the app's tx grammar is unchanged. A malformed
+        envelope surfaces as an invalid-format payload (the ingest
+        pipeline rejects those before the app when enabled)."""
+        from ..ingest.tx import MalformedTx, unwrap_payload
+        try:
+            return unwrap_payload(tx)
+        except MalformedTx:
+            return tx
+
     def check_tx(self, tx: bytes) -> CheckTxResult:
+        tx = self._unwrap(tx)
         if self.is_validator_tx(tx):
             try:
                 self._parse_validator_tx(tx)
@@ -121,6 +135,7 @@ class KVStoreApplication(BaseApplication):
         state = dict(self.state)
         results, updates = [], []
         for tx in req.txs:
+            tx = self._unwrap(tx)
             if self.is_validator_tx(tx):
                 try:
                     upd = self._parse_validator_tx(tx)

@@ -127,6 +127,11 @@ class MempoolConfig:
     max_tx_bytes: int = 1024 * 1024
     max_txs_bytes: int = 64 * 1024 * 1024
     recheck: bool = True
+    # route broadcast_tx_* / p2p-relayed txs through the batched
+    # admission pipeline (ingest/ — docs/INGEST.md): envelope
+    # signatures coalesce into shared device batches with explicit
+    # backpressure, instead of a synchronous per-tx check_tx
+    ingest_batch: bool = False
 
 
 @dataclass

@@ -29,7 +29,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from ..libs.env import env_float, env_int
+from ..libs.env import env_bool, env_float, env_int
 from ..libs.fail import fail_point
 from ..pipeline.cache import SigCache
 from ..types.validation import ErrWrongSignature
@@ -37,13 +37,51 @@ from .planner import Lane, PlannedCheck
 
 ENV_MAX_PENDING_LANES = "COMETBFT_TPU_FARM_MAX_PENDING_LANES"
 ENV_COALESCE_WINDOW = "COMETBFT_TPU_FARM_COALESCE_WINDOW"
+ENV_ADAPTIVE_WINDOW = "COMETBFT_TPU_FARM_ADAPTIVE_WINDOW"
 DEFAULT_MAX_PENDING_LANES = 16_384
 DEFAULT_COALESCE_WINDOW_S = 0.002
 # a wedged flush must surface, not hang an RPC worker forever; the
 # device seam's own deadline (device/client.deadline_for) is far below
 FLUSH_WAIT_S = 120.0
 
+# adaptive coalescing: the fixed window splits into this many sub-polls,
+# and once PLATEAU_POLLS consecutive polls observe the same pending
+# width the waiter flushes early — at low load a lone submitter pays
+# window/ADAPTIVE_POLLS*2 instead of the full window, while a still-
+# growing batch keeps coalescing up to the fixed ceiling (ROADMAP
+# item 4 headroom: the fixed knob stays the ceiling).
+ADAPTIVE_POLLS = 4
+PLATEAU_POLLS = 2
+
 ED25519 = "ed25519"
+
+
+def coalesce_wait(ev: threading.Event, window_s: float,
+                  width_fn: Callable[[], int], adaptive: bool) -> bool:
+    """Wait for `ev` up to the coalescing window; returns True iff the
+    event fired (someone else's flush resolved the ticket). With
+    `adaptive`, the window is sampled in ADAPTIVE_POLLS sub-polls of
+    `width_fn` (the pending queue width): when PLATEAU_POLLS
+    consecutive polls see no growth the batch has stopped widening and
+    waiting longer only adds tail latency — return early so the caller
+    flushes now. Shared by the farm and ingest batchers."""
+    if window_s <= 0:
+        return ev.is_set()
+    if not adaptive:
+        return ev.wait(window_s)
+    poll = window_s / ADAPTIVE_POLLS
+    last, flat = -1, 0
+    for _ in range(ADAPTIVE_POLLS):
+        if ev.wait(poll):
+            return True
+        width = width_fn()
+        if width == last:
+            flat += 1
+            if flat >= PLATEAU_POLLS - 1:
+                return False  # width plateaued: flush early
+        else:
+            last, flat = width, 0
+    return False
 
 
 class QueueFull(Exception):
@@ -119,7 +157,7 @@ class FarmBatcher:
                  max_pending_lanes: Optional[int] = None,
                  coalesce_window_s: Optional[float] = None,
                  verify_backend: Optional[Callable] = None,
-                 metrics=None):
+                 metrics=None, adaptive: Optional[bool] = None):
         if max_pending_lanes is None:
             max_pending_lanes = env_int(ENV_MAX_PENDING_LANES,
                                         DEFAULT_MAX_PENDING_LANES,
@@ -128,8 +166,11 @@ class FarmBatcher:
             coalesce_window_s = env_float(ENV_COALESCE_WINDOW,
                                           DEFAULT_COALESCE_WINDOW_S,
                                           minimum=0.0)
+        if adaptive is None:
+            adaptive = env_bool(ENV_ADAPTIVE_WINDOW, True)
         self.max_pending_lanes = max_pending_lanes
         self.coalesce_window_s = coalesce_window_s
+        self.adaptive = adaptive
         self.cache = cache if cache is not None else SigCache(0)
         self.metrics = metrics  # libs/metrics_gen.FarmMetrics or None
         self._backend = verify_backend or device_or_cpu_backend
@@ -184,14 +225,20 @@ class FarmBatcher:
 
     def wait(self, tickets: Sequence[CheckTicket]) -> None:
         """Block until every ticket resolves, coalescing with other
-        submitters: wait one window for someone else's flush, then
-        flush whatever is pending ourselves."""
+        submitters: wait up to one window for someone else's flush
+        (adaptively cut short once the pending width plateaus —
+        coalesce_wait), then flush whatever is pending ourselves."""
         for ticket in tickets:
-            if ticket._ev.wait(self.coalesce_window_s):
+            if coalesce_wait(ticket._ev, self.coalesce_window_s,
+                             self._pending_width, self.adaptive):
                 continue
             self.flush()
             if not ticket._ev.wait(FLUSH_WAIT_S):
                 raise RuntimeError("farm flush did not resolve ticket")
+
+    def _pending_width(self) -> int:
+        with self._lock:
+            return self._pending_lanes
 
     # --- the shared batch -------------------------------------------------
 

@@ -1,0 +1,22 @@
+"""ingest/ — batched CheckTx admission pipeline + async RPC front door
+(docs/INGEST.md).
+
+Coalesces concurrent `broadcast_tx_*` / p2p-relayed txs into shared
+signature-verification batches over the SigCache + DeviceClient seam
+(the same amortization vote intake, blocksync, and the farm already
+ride), with explicit backpressure (bounded queue, IngestShed) and
+verdict application that is a byte-for-byte drop-in for sequential
+`mempool.check_tx`.
+
+  tx.py          signed-tx envelope (magic | pub | sig | payload)
+  admission.py   bounded intake queue, two-layer dedup, coalescing
+  batcher.py     unique-lane dedup + canary/supervisor device dispatch
+  dispatcher.py  in-order verdict application into mempool semantics
+"""
+
+from .admission import (CACHE_PATH, IngestPipeline, IngestShed,  # noqa: F401
+                        TxFilter, TxTicket)
+from .batcher import IngestBatcher, SigLane, native_backend  # noqa: F401
+from .dispatcher import CODE_BAD_SIGNATURE, VerdictDispatcher  # noqa: F401
+from .tx import (MalformedTx, SignedTx, make_signed_tx,  # noqa: F401
+                 parse_signed_tx, sign_bytes, unwrap_payload)

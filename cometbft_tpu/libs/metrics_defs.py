@@ -90,6 +90,39 @@ METRICS_SPEC = {
          "Requests shed by backpressure (session cap or lane queue)",
          ()),
     ],
+    # ingest/ — the batched CheckTx admission pipeline (admission.py,
+    # batcher.py, dispatcher.py): broadcast_tx_* / p2p-relayed txs
+    # coalesced into shared signature batches with explicit
+    # backpressure (docs/INGEST.md)
+    "IngestMetrics": [
+        ("gauge", "queue_depth", "ingest_queue_depth",
+         "Txs parked in the admission queue awaiting a batch flush",
+         ()),
+        ("gauge", "batch_width", "ingest_batch_width",
+         "Unique signature lanes in the most recent admission batch",
+         ()),
+        ("counter", "batches", "ingest_batches",
+         "Coalesced admission batches flushed", ()),
+        ("counter", "admitted", "ingest_admitted_txs",
+         "Txs admitted into the mempool through the ingest pipeline",
+         ()),
+        ("counter", "rejected", "ingest_rejected_txs",
+         "Txs rejected at admission, by reason (sig=bad envelope "
+         "signature, app=app CheckTx code, mempool=structural)",
+         ("reason",)),
+        ("counter", "shed", "ingest_shed_total",
+         "Txs shed by admission-queue backpressure", ()),
+        ("counter", "dedup_hits", "ingest_dedup_hits",
+         "Admission dedup hits (txhash=duplicate filter, batch=intra-"
+         "batch lane collapse; SigCache hits show under "
+         "pipeline_sigcache_hits path=ingest)", ("kind",)),
+        ("counter", "lanes", "ingest_lanes_verified",
+         "Tx signature lanes verified, by backend (device vs cpu)",
+         ("backend",)),
+        ("histogram", "admission_latency",
+         "ingest_admission_latency_seconds",
+         "Submit-to-verdict admission latency, seconds", ()),
+    ],
     # reference mempool/metrics.go
     "MempoolMetrics": [
         ("gauge", "size", "mempool_size",

@@ -112,6 +112,11 @@ class CListMempool:
         self._height = 0
         self._update_lock = threading.RLock()
         self._notify: List[Callable[[], None]] = []
+        # cache-eviction observers (ingest/admission.TxFilter mirrors
+        # this cache: a tx the mempool forgets must be resubmittable
+        # through the front door too). cb(key) per eviction; cb(None)
+        # on a wholesale reset (flush)
+        self._evict_cbs: List[Callable[[Optional[bytes]], None]] = []
         # optional generated metrics struct
         # (libs/metrics_gen.MempoolMetrics — reference
         # mempool/metrics.go); None until the node wires it
@@ -137,6 +142,7 @@ class CListMempool:
             if code != CODE_TYPE_OK:
                 if not self._keep_invalid:
                     self.cache.remove(key)
+                    self._fire_evict(key)
                 if self.metrics is not None:
                     self.metrics.failed_txs.inc()
                 return code
@@ -156,6 +162,16 @@ class CListMempool:
         """Subscribe to tx arrival with the admitted tx (gossip relay /
         consensus wake-up)."""
         self._notify.append(cb)
+
+    def on_tx_evicted(self, cb: Callable[[Optional[bytes]], None]) -> None:
+        """Subscribe to seen-cache evictions: cb(tx_key) whenever an
+        invalid/rechecked tx is dropped from the cache, cb(None) when
+        the cache resets wholesale."""
+        self._evict_cbs.append(cb)
+
+    def _fire_evict(self, key: Optional[bytes]) -> None:
+        for cb in self._evict_cbs:
+            cb(key)
 
     # --- reaping -------------------------------------------------------------
 
@@ -210,6 +226,7 @@ class CListMempool:
                 self.cache.push(key)
             elif not self._keep_invalid:
                 self.cache.remove(key)
+                self._fire_evict(key)
             mt = self._txs.pop(key, None)
             if mt is not None:
                 self._bytes -= len(mt.tx)
@@ -230,6 +247,7 @@ class CListMempool:
                 self._bytes -= len(mt.tx)
                 if not self._keep_invalid:
                     self.cache.remove(key)
+                    self._fire_evict(key)
                 if self.metrics is not None:
                     self.metrics.evicted_txs.inc()
             else:
@@ -240,6 +258,7 @@ class CListMempool:
             self._txs.clear()
             self._bytes = 0
             self.cache.reset()
+            self._fire_evict(None)
             self._set_gauges()
 
     # --- introspection -------------------------------------------------------

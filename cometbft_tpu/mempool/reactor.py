@@ -17,8 +17,13 @@ MEMPOOL_CHANNEL = 0x30
 
 
 class MempoolReactor:
-    def __init__(self, mempool):
+    def __init__(self, mempool, ingest=None):
         self.mempool = mempool
+        # ingest/admission.IngestPipeline when [mempool] ingest_batch
+        # is on: relayed txs then coalesce into the same shared
+        # signature batches as RPC traffic instead of walking a
+        # synchronous check_tx on the p2p read thread
+        self.ingest = ingest
         self._switch = None
         mempool.on_new_tx(self._on_local_admit)
         self._relaying: List[bytes] = []
@@ -38,6 +43,11 @@ class MempoolReactor:
         pass
 
     def receive(self, channel_id: int, peer, tx: bytes) -> None:
+        if self.ingest is not None:
+            # fire-and-forget: duplicates/sheds drop silently and the
+            # background flusher settles the ticket off-thread
+            self.ingest.submit_nowait(tx)
+            return
         try:
             self.mempool.check_tx(tx)
         except ValueError:

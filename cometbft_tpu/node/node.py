@@ -219,6 +219,18 @@ class Node:
         from ..pipeline.cache import shared_cache
         if shared_cache().metrics is None:
             shared_cache().metrics = self.pipeline_metrics
+        # batched CheckTx admission ([mempool] ingest_batch —
+        # docs/INGEST.md): broadcast_tx_* and p2p-relayed txs coalesce
+        # into shared signature batches over the same SigCache +
+        # DeviceClient seam as vote intake and blocksync, with
+        # explicit backpressure
+        self.ingest = None
+        if mc.ingest_batch:
+            from ..ingest import IngestPipeline
+            from ..libs.metrics_gen import IngestMetrics
+            self.ingest = IngestPipeline(
+                self.mempool, cache=shared_cache(),
+                metrics=IngestMetrics(self.metrics_registry))
         cc = config.consensus
         self.consensus = ConsensusState(
             ConsensusConfig(
@@ -253,7 +265,8 @@ class Node:
         self.consensus_reactor.attach(self.switch)
         self.blocksync_reactor = BlocksyncNetReactor(self.block_store)
         from ..mempool.reactor import MempoolReactor
-        self.mempool_reactor = MempoolReactor(self.mempool)
+        self.mempool_reactor = MempoolReactor(self.mempool,
+                                              ingest=self.ingest)
         self.mempool_reactor.attach(self.switch)
         from ..evidence.reactor import EvidenceReactor
         self.evidence_reactor = EvidenceReactor(
@@ -295,7 +308,8 @@ class Node:
             app_query=self.app_conns.query, genesis=self.genesis,
             switch=self.switch,
             evidence_pool=self.evidence_pool,
-            unsafe=config.rpc.unsafe, farm=self.farm)
+            unsafe=config.rpc.unsafe, farm=self.farm,
+            ingest=self.ingest)
         self.rpc_server: Optional[RPCServer] = None
         if config.rpc.enable:
             host, port = self._split_addr(config.rpc.laddr)
@@ -365,6 +379,10 @@ class Node:
     # --- lifecycle (node.go:539-609) -----------------------------------------
 
     def start(self) -> None:
+        if self.ingest is not None:
+            # flusher first: relayed/async txs must settle even before
+            # any RPC waiter performs a cooperative flush
+            self.ingest.start()
         if self.rpc_server is not None:
             self.rpc_server.start()          # RPC first (node.go:559)
         if self.grpc_services is not None:
@@ -598,6 +616,8 @@ class Node:
     def stop(self) -> None:
         self.consensus.stop()
         self.consensus_reactor.stop()
+        if self.ingest is not None:
+            self.ingest.stop()
         if getattr(self, "_metrics_server", None) is not None:
             self._metrics_server.shutdown()
             self._metrics_server.server_close()  # free the listen FD

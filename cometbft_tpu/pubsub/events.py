@@ -57,8 +57,16 @@ class EventBus:
     def __init__(self):
         self.server = PubSubServer()
 
-    def subscribe(self, subscriber: str, query: Query) -> Subscription:
-        return self.server.subscribe(subscriber, query)
+    def subscribe(self, subscriber: str, query: Query,
+                  buffer: int = None) -> Subscription:
+        """Bounded, non-blocking subscription: a subscriber that falls
+        behind `buffer` pending events loses the oldest (drop-oldest,
+        counted on the subscription) rather than stalling the
+        publisher."""
+        from .pubsub import DEFAULT_SUB_BUFFER
+        return self.server.subscribe(
+            subscriber, query,
+            buffer if buffer is not None else DEFAULT_SUB_BUFFER)
 
     def unsubscribe_all(self, subscriber: str) -> None:
         self.server.unsubscribe_all(subscriber)

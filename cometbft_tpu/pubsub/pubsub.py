@@ -16,12 +16,21 @@ class SubscriptionError(Exception):
     pass
 
 
+DEFAULT_SUB_BUFFER = 100
+
+
 @dataclass
 class Subscription:
     subscriber: str
     query: Query
-    out: "queue.Queue" = dc_field(default_factory=lambda: queue.Queue(100))
+    out: "queue.Queue" = dc_field(
+        default_factory=lambda: queue.Queue(DEFAULT_SUB_BUFFER))
     cancelled: bool = False
+    # events discarded by the drop-oldest policy because this
+    # subscriber fell behind its bounded buffer — the fan-out is
+    # non-blocking by contract, so lag is visible here, never as a
+    # stalled publisher
+    dropped: int = 0
 
     def next(self, timeout: Optional[float] = None):
         """Blocking read of the next published message; None on cancel."""
@@ -39,7 +48,7 @@ class PubSubServer:
         self._lock = threading.RLock()
 
     def subscribe(self, subscriber: str, query: Query,
-                  buffer: int = 100) -> Subscription:
+                  buffer: int = DEFAULT_SUB_BUFFER) -> Subscription:
         key = (subscriber, query.raw)
         with self._lock:
             if key in self._subs:
@@ -63,9 +72,12 @@ class PubSubServer:
                 self._subs.pop(k).cancelled = True
 
     def publish(self, msg: Any, events: Dict[str, List[str]]) -> None:
-        """Deliver to every matching subscription; a full buffer drops
-        the oldest entry (the reference cancels slow subscribers — for
-        an embedded bus, sliding is friendlier and still bounded)."""
+        """Deliver to every matching subscription — NEVER blocking the
+        publisher (the consensus/executor thread): a full buffer drops
+        the oldest entry and counts it on the subscription (the
+        reference cancels slow subscribers — for an embedded bus,
+        sliding is friendlier, still bounded, and the lag is
+        observable via `Subscription.dropped`)."""
         with self._lock:
             subs = list(self._subs.values())
         for sub in subs:
@@ -75,12 +87,13 @@ class PubSubServer:
                 except queue.Full:
                     try:
                         sub.out.get_nowait()
+                        sub.dropped += 1
                     except queue.Empty:
                         pass
                     try:
                         sub.out.put_nowait((msg, events))
                     except queue.Full:
-                        pass
+                        sub.dropped += 1
 
     def num_subscriptions(self) -> int:
         with self._lock:

@@ -37,12 +37,17 @@ class RPCEnvironment:
                  mempool=None, consensus=None, event_bus=None,
                  tx_indexer=None, block_indexer=None, app_query=None,
                  genesis=None, switch=None, state_getter=None,
-                 evidence_pool=None, unsafe=False, farm=None):
+                 evidence_pool=None, unsafe=False, farm=None,
+                 ingest=None):
         self.chain_id = chain_id
         # farm/service.VerificationFarm when the node serves light
         # verification as a product; None leaves the light_* routes
         # unmounted
         self.farm = farm
+        # ingest/admission.IngestPipeline when [mempool] ingest_batch
+        # is on: broadcast_tx_* then park on a batch ticket instead of
+        # walking a synchronous check_tx (docs/INGEST.md)
+        self.ingest = ingest
         self.block_store = block_store
         self.state_store = state_store
         self.mempool = mempool
@@ -281,7 +286,26 @@ class Routes:
     # --- txs -----------------------------------------------------------------
 
     def broadcast_tx_sync(self, tx="") -> dict:
+        """Admit a tx. With the ingest pipeline mounted, the request
+        PARKS on a future until its coalesced signature batch settles
+        (the async ingest seam — docs/INGEST.md); a full admission
+        queue sheds with the retryable -32005 overload code. Without
+        it, the original synchronous check_tx path."""
         raw = bytes.fromhex(tx)
+        ing = self.env.ingest
+        if ing is not None:
+            from ..ingest import IngestShed
+            try:
+                ticket = ing.submit(raw)
+            except IngestShed as e:
+                raise RPCError(-32005, f"ingest overloaded: {e}")
+            except ValueError as e:
+                raise RPCError(-32603, str(e)) from e
+            ing.wait([ticket])
+            if ticket.error is not None:
+                raise RPCError(-32603, str(ticket.error))
+            return {"code": ticket.code,
+                    "hash": tx_hash(raw).hex().upper()}
         try:
             code = self.env.mempool.check_tx(raw)
         except ValueError as e:
@@ -295,6 +319,17 @@ class Routes:
         return {"hash": tx_hash(raw).hex().upper()}
 
     def _checked(self, raw: bytes) -> None:
+        ing = self.env.ingest
+        if ing is not None:
+            # fire-and-forget through the batch path: the waiter's
+            # cooperative flush (or the background flusher) settles it
+            ticket = ing.submit_nowait(raw)
+            if ticket is not None:
+                try:
+                    ing.wait([ticket])
+                except RuntimeError:
+                    pass
+            return
         try:
             self.env.mempool.check_tx(raw)
         except ValueError:
@@ -482,10 +517,30 @@ class Routes:
 
     def check_tx(self, tx="") -> dict:
         """Run CheckTx without adding to the mempool (reference
-        /check_tx → app CheckTx on the query path)."""
-        r = self.env.app_query.check_tx(bytes.fromhex(tx))
+        /check_tx → app CheckTx on the query path). With the ingest
+        pipeline mounted, the tx-hash duplicate filter and the
+        SigCache are consulted FIRST: a tx the admission path already
+        knows answers without an app round trip, and a signed
+        envelope's verdict rides the cache — `cached` reports when
+        either shortcut fired."""
+        raw = bytes.fromhex(tx)
+        ing = self.env.ingest
+        cached = False
+        if ing is not None:
+            from ..ingest import CODE_BAD_SIGNATURE
+            known, sig_ok, sig_cached = ing.query_cached(raw)
+            if known:
+                return {"code": 0, "log": "tx already known to the "
+                        "admission filter", "gas_wanted": 0,
+                        "cached": True}
+            if sig_ok is False:
+                return {"code": CODE_BAD_SIGNATURE,
+                        "log": "invalid envelope signature",
+                        "gas_wanted": 0, "cached": sig_cached}
+            cached = sig_cached
+        r = self.env.app_query.check_tx(raw)
         return {"code": r.code, "log": r.log,
-                "gas_wanted": r.gas_wanted}
+                "gas_wanted": r.gas_wanted, "cached": cached}
 
     def genesis_chunked(self, chunk=None) -> dict:
         import base64

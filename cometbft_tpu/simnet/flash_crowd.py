@@ -1,0 +1,341 @@
+"""flash-crowd scenario: thousands of seeded virtual clients flood the
+batched admission pipeline — deterministically.
+
+Like light-farm, this scenario runs no nodes and no network: the
+simulated population is the CLIENT crowd hammering one node's ingest
+front door. A seeded PRNG draws every client's tx mix (fresh signed,
+duplicate, tampered signature, bare, malformed, app-invalid), the
+pipeline is driven single-threaded through explicit flush waves, and
+the whole run — batch widths, shed counts, duplicate-filter hits,
+admission verdicts, recheck evictions — is a pure function of
+(scenario, seed); the event log is byte-identical per seed
+(tests/test_simnet.py pins it, the same contract as every scenario).
+
+Signatures here are a deterministic MAC stub (sig = H(pub‖msg)‖H) run
+through the REAL pipeline with an injected verify backend: what this
+scenario pins is admission behavior under bursty overload — dedup,
+shed, FIFO apply order, recheck-eviction release — not curve math
+(tests/test_ingest.py covers real ed25519 envelopes; pure-Python
+ed25519 at ~6ms/op would cap the crowd at hundreds, not thousands).
+
+Phases per round: a burst wave (every client submits at once; the
+bounded queue overruns, sheds, and clears on flush-then-retry — the
+documented backpressure contract) → a commit (reap + update + recheck
+against a freshly poisoned key set; evicted txs must release the
+duplicate filter) → resubmission of every evicted tx (must re-enter
+via the SigCache without a new lane).
+
+Invariant probes:
+  * verdict exactness — every tampered signature rejects with
+    CODE_BAD_SIGNATURE; every malformed envelope and duplicate
+    rejects structurally; no ticket is ever left unresolved;
+  * mempool agreement — after every round the mempool's FIFO contents
+    equal a host-side shadow model replaying the logged decisions;
+  * shed + dedup exactness — the bounded queue must actually shed and
+    the duplicate filter must actually hit (a crowd that never
+    overruns pins nothing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time as _walltime
+from typing import Dict, List, Tuple
+
+import random
+
+from ..ingest import CODE_BAD_SIGNATURE, IngestPipeline, IngestShed
+from ..ingest.tx import MAGIC, sign_bytes, unwrap_payload
+from ..mempool.mempool import CListMempool, tx_key
+from ..pipeline.cache import SigCache
+from .harness import SimResult
+
+_MAC_DOMAIN = b"flash-crowd-mac:"
+
+
+def _mac_sig(pub: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha256(_MAC_DOMAIN + pub + msg).digest()
+    return h + h  # 64 bytes, the envelope's signature width
+
+
+def mac_backend(lanes) -> Tuple[List[bool], str]:
+    """Deterministic stub verify backend: a lane passes iff its sig is
+    the MAC of (pub, msg) — same dedup/verdict plumbing as ed25519,
+    microseconds per lane."""
+    return [lane.sig == _mac_sig(lane.pub, lane.msg)
+            for lane in lanes], "stub"
+
+
+def _signed(pub: bytes, payload: bytes, good: bool = True) -> bytes:
+    sig = _mac_sig(pub, sign_bytes(payload))
+    if not good:
+        sig = bytes([sig[0] ^ 0x01]) + sig[1:]
+    return MAGIC + pub + sig + payload
+
+
+class _CrowdSim:
+    def __init__(self, scenario, seed: int, quick: bool):
+        self.name = scenario.name
+        self.seed = seed
+        if quick:
+            self.n_clients, self.rounds = 200, 2
+        else:
+            self.n_clients, self.rounds = 2000, 3
+        self.queue_cap = max(8, self.n_clients // 3)
+        self.commit_reap = max(4, self.n_clients // 4)
+        self.rng = random.Random(f"simnet:{scenario.name}:{seed}")
+        self.log_lines: List[str] = []
+        self.violations: List[str] = []
+        self.admitted = 0
+        self.rejected = 0
+        self.shed = 0
+        self.dups = 0
+        # one 32-byte "pubkey" per client (MAC identity, not a curve
+        # point — the injected backend never does curve math)
+        self.pubs = [hashlib.sha256(
+            f"flash-crowd:{seed}:client{i}".encode()).digest()
+            for i in range(self.n_clients)]
+        self.sent_good: List[bytes] = []   # resubmission candidates
+        self.banned: set = set()           # app-side poisoned payload keys
+        self.shadow: List[bytes] = []      # expected mempool FIFO keys
+        self.evicted_payloads: List[bytes] = []
+
+    def log(self, event: str, **kw) -> None:
+        fields = " ".join(f"{k}={v}" for k, v in kw.items())
+        self.log_lines.append(f"{event} {fields}".rstrip())
+
+    def violation(self, msg: str) -> None:
+        self.log("violation", msg=msg.replace(" ", "_"))
+        self.violations.append(msg)
+
+    # --- the app stub ------------------------------------------------------
+
+    def _check_fn(self, tx: bytes) -> Tuple[int, int]:
+        payload = unwrap_payload(tx)
+        if b"=" not in payload:
+            return 1, 0
+        key = payload.split(b"=", 1)[0]
+        if key in self.banned:
+            return 2, 0
+        return 0, 1
+
+    # --- run ---------------------------------------------------------------
+
+    def run(self) -> SimResult:
+        t0 = _walltime.perf_counter()  # staticcheck: allow(wallclock)
+        self.mempool = CListMempool(self._check_fn,
+                                    size=4 * self.n_clients,
+                                    cache_size=8 * self.n_clients)
+        self.pipe = IngestPipeline(
+            self.mempool, cache=SigCache(65536), batch=True,
+            max_pending=self.queue_cap, coalesce_window_s=0.0,
+            verify_backend=mac_backend)
+        self.log("start", scenario=self.name, seed=self.seed,
+                 clients=self.n_clients, rounds=self.rounds,
+                 queue_cap=self.queue_cap)
+        for r in range(1, self.rounds + 1):
+            self._resubmit_evicted(r)
+            self._burst_wave(r)
+            self._commit_round(r)
+            self._check_mempool_agreement(r)
+        self._final_checks()
+        st = self.pipe.stats()
+        self.log("end", admitted=self.admitted, rejected=self.rejected,
+                 shed=self.shed, dups=self.dups,
+                 batches=st["batches"],
+                 max_width=st["max_batch_width"],
+                 dedup_batch=st["dedup_batch_hits"],
+                 cache_rate=st["cache_hit_rate"],
+                 mempool=self.mempool.size(),
+                 violations=len(self.violations))
+        digest = hashlib.sha256()
+        for line in self.log_lines:
+            digest.update(line.encode())
+            digest.update(b"\n")
+        return SimResult(
+            scenario=self.name, seed=self.seed,
+            violations=self.violations,
+            max_height=self.rounds, heights={},
+            app_hashes={}, log_lines=self.log_lines,
+            digest=digest.hexdigest(),
+            # staticcheck: allow(wallclock) — wall_s never enters the log
+            wall_s=_walltime.perf_counter() - t0,
+            virtual_s=0.0, commits_per_sim_s=0.0,
+            crashes=0, restarts=0, evidence_seen=0, errors=[],
+            stats={"delivered": self.admitted, "dropped": self.rejected,
+                   "blocked": self.shed, "events": st["batches"]})
+
+    # --- phases ------------------------------------------------------------
+
+    def _build_tx(self, i: int, r: int) -> Tuple[str, bytes]:
+        """(kind, tx) from the seeded mix."""
+        pub = self.pubs[i]
+        p = self.rng.random()
+        if p < 0.10 and self.sent_good:
+            return "dup", self.sent_good[
+                self.rng.randrange(len(self.sent_good))]
+        if p < 0.18:
+            return "badsig", _signed(
+                pub, f"x{i}r{r}=bad".encode(), good=False)
+        if p < 0.23:
+            return "bare", f"bare{i}r{r}=v".encode()
+        if p < 0.27:
+            return "appbad", _signed(pub, f"noequals{i}r{r}".encode())
+        if p < 0.30:
+            return "malformed", MAGIC + bytes(10)
+        return "good", _signed(
+            pub, f"k{i}r{r}={self.rng.randrange(1 << 16)}".encode())
+
+    def _submit(self, i: int, r: int, kind: str, tx: bytes):
+        """One client's submission with the flush-then-retry-once shed
+        discipline; returns the ticket (or None if fully rejected)."""
+        try:
+            return self.pipe.submit(tx)
+        except IngestShed:
+            self.shed += 1
+            self.log("shed", client=i, round=r)
+            width = self.pipe.flush()
+            self.log("flush", round=r, width=width, cause="shed")
+            try:
+                return self.pipe.submit(tx)
+            except (IngestShed, ValueError) as e:
+                self.rejected += 1
+                self.log("reject", client=i, round=r, kind=kind,
+                         reason=type(e).__name__)
+                return None
+        except ValueError as e:
+            self.rejected += 1
+            if kind == "dup":
+                self.dups += 1
+                self.log("dup", client=i, round=r)
+            else:
+                self.log("reject", client=i, round=r, kind=kind,
+                         reason=type(e).__name__)
+            return None
+
+    def _burst_wave(self, r: int) -> None:
+        wave = []
+        for i in range(self.n_clients):
+            kind, tx = self._build_tx(i, r)
+            ticket = self._submit(i, r, kind, tx)
+            if ticket is not None:
+                wave.append((i, kind, tx, ticket))
+        width = self.pipe.flush()
+        self.log("flush", round=r, width=width, cause="wave")
+        admitted_w = 0
+        for i, kind, tx, ticket in wave:
+            if not ticket.done():
+                self.violation(f"unresolved ticket client {i} round {r}")
+                continue
+            if ticket.code == 0:
+                admitted_w += 1
+                self.admitted += 1
+                self.shadow.append(ticket.key)
+                if kind == "good":
+                    self.sent_good.append(tx)
+                if kind == "badsig":
+                    self.violation(
+                        f"tampered signature admitted (client {i})")
+            else:
+                self.rejected += 1
+                self.log("reject", client=i, round=r, kind=kind,
+                         code=ticket.code)
+                if kind == "badsig" and \
+                        ticket.code != CODE_BAD_SIGNATURE:
+                    self.violation(
+                        f"bad-sig tx rejected with {ticket.code}, "
+                        f"not CODE_BAD_SIGNATURE")
+                if kind == "good" and ticket.error is None \
+                        and ticket.code != 0:
+                    self.violation(
+                        f"clean tx rejected code={ticket.code}")
+        self.log("wave", round=r, admitted=admitted_w,
+                 queued=self.pipe.stats()["queued"])
+
+    def _commit_round(self, r: int) -> None:
+        """Reap a block, poison a seeded subset of surviving payload
+        keys, and update: recheck must evict exactly the poisoned txs
+        and release them from the duplicate filter."""
+        reaped = self.mempool.reap_max_txs(self.commit_reap)
+        survivors = self.mempool.reap_max_txs(-1)[len(reaped):]
+        pool = sorted({unwrap_payload(t).split(b"=", 1)[0]
+                       for t in survivors if b"=" in unwrap_payload(t)})
+        n_ban = min(len(pool), max(1, len(pool) // 10))
+        newly_banned = [pool[self.rng.randrange(len(pool))]
+                        for _ in range(n_ban)] if pool else []
+        self.banned.update(newly_banned)
+        before = self.mempool.size()
+        self.mempool.update(r, reaped)
+        evicted = before - len(reaped) - self.mempool.size()
+        self.log("commit", round=r, reaped=len(reaped),
+                 banned=len(newly_banned), evicted=evicted)
+        # maintain the shadow model: committed leave, poisoned evict
+        reaped_keys = {tx_key(t) for t in reaped}
+        evicted_keys = set()
+        for t in survivors:
+            payload = unwrap_payload(t)
+            if b"=" in payload and payload.split(b"=", 1)[0] in self.banned:
+                evicted_keys.add(tx_key(t))
+                self.evicted_payloads.append(t)
+        self.shadow = [k for k in self.shadow
+                       if k not in reaped_keys and k not in evicted_keys]
+
+    def _resubmit_evicted(self, r: int) -> None:
+        """Every recheck-evicted tx must be resubmittable (the filter
+        released it) — and must ride the SigCache: no fresh lane."""
+        if not self.evicted_payloads:
+            return
+        txs, self.evicted_payloads = self.evicted_payloads, []
+        lanes_before = self.pipe.cache.hits.get("ingest", 0)
+        wave = []
+        for n, tx in enumerate(txs):
+            # un-poison so the app accepts the retried tx this time
+            payload = unwrap_payload(tx)
+            self.banned.discard(payload.split(b"=", 1)[0])
+            try:
+                wave.append((n, self.pipe.submit(tx)))
+            except (IngestShed, ValueError) as e:
+                self.violation(
+                    f"evicted tx resubmission rejected ({type(e).__name__})")
+        width = self.pipe.flush()
+        cache_hits = self.pipe.cache.hits.get("ingest", 0) - lanes_before
+        self.log("resubmit", round=r, n=len(txs), width=width,
+                 cache_hits=cache_hits)
+        if width != 0:
+            self.violation(
+                "resubmitted evicted txs dispatched fresh lanes "
+                "(SigCache miss)")
+        for n, ticket in wave:
+            if ticket.code == 0:
+                self.admitted += 1
+                self.shadow.append(ticket.key)
+            else:
+                self.violation(
+                    f"evicted tx resubmission denied code={ticket.code}")
+
+    # --- oracles -----------------------------------------------------------
+
+    def _check_mempool_agreement(self, r: int) -> None:
+        got = [tx_key(t) for t in self.mempool.reap_max_txs(-1)]
+        if got != self.shadow:
+            self.violation(
+                f"mempool FIFO diverged from shadow model at round {r} "
+                f"({len(got)} vs {len(self.shadow)} txs)")
+
+    def _final_checks(self) -> None:
+        if self.shed == 0:
+            self.violation("shed path never exercised (queue cap "
+                           "was not reached)")
+        if self.dups == 0:
+            self.violation("duplicate filter never hit")
+        st = self.pipe.stats()
+        if st["queued"] != 0:
+            self.violation(f"{st['queued']} txs stranded in the queue")
+
+
+def run_flash_crowd(scenario, seed: int, quick: bool = False,
+                    workdir=None) -> SimResult:
+    """Scenario runner (scenarios.py dispatches here; `workdir` is part
+    of the runner contract but unused — the crowd sim touches no
+    files)."""
+    return _CrowdSim(scenario, seed, quick).run()

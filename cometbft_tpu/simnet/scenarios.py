@@ -20,6 +20,7 @@ from ..consensus.reactor import (DATA_CHANNEL, VOTE_CHANNEL, _BLOCK_PART,
 from ..types.block import BlockID
 from ..types.vote import Vote
 from .clock import MS
+from .flash_crowd import run_flash_crowd as _run_flash_crowd
 from .harness import Scenario, Simulation
 from .light_farm import run_light_farm as _run_light_farm
 from .transport import LinkPolicy
@@ -233,6 +234,14 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
              "LightClient.tla acceptance rules",
              target_height=20, deadline_ms=0,
              runner=_run_light_farm),
+    Scenario("flash-crowd", "thousands of seeded virtual clients burst "
+             "signed txs at the batched admission pipeline; the bounded "
+             "queue sheds, the duplicate filter hits, tampered "
+             "signatures reject, recheck-evicted txs re-enter via the "
+             "SigCache, and the mempool's FIFO matches a shadow model "
+             "replay",
+             target_height=3, deadline_ms=0,
+             runner=_run_flash_crowd),
 ]}
 
 

@@ -532,3 +532,70 @@ def test_batcher_queue_full_direct(chain):
     assert len(check.lanes) > 2
     with pytest.raises(QueueFull):
         b.submit(check)
+
+
+# --- adaptive coalescing window (ROADMAP item 4 headroom) ---------------------
+
+
+def test_coalesce_wait_plateau_flushes_at_half_window():
+    """Tail-latency pin: a lone submitter (pending width never grows)
+    flushes after 2 of the 4 sub-polls — half the fixed window — while
+    the fixed knob stays the ceiling for a still-growing batch."""
+    import threading
+    import time as _t
+    from cometbft_tpu.farm.batcher import (ADAPTIVE_POLLS, coalesce_wait)
+
+    window = 0.4
+    ev = threading.Event()  # never set: nobody else flushes
+
+    # plateau: width constant -> early flush at 2 polls (window/2)
+    t0 = _t.perf_counter()
+    fired = coalesce_wait(ev, window, lambda: 3, adaptive=True)
+    plateau_dt = _t.perf_counter() - t0
+    assert fired is False
+    assert plateau_dt < window * 0.9  # strictly beat the fixed window
+    assert plateau_dt >= window / ADAPTIVE_POLLS * 0.5
+
+    # growing batch: width changes every poll -> wait the full ceiling
+    widths = iter(range(100))
+    t0 = _t.perf_counter()
+    fired = coalesce_wait(ev, window, lambda: next(widths), adaptive=True)
+    growing_dt = _t.perf_counter() - t0
+    assert fired is False
+    assert growing_dt >= window * 0.95
+
+    # the adaptive path is the tail-latency improvement
+    assert plateau_dt < growing_dt / 1.5
+
+    # non-adaptive: the original fixed wait
+    t0 = _t.perf_counter()
+    assert coalesce_wait(ev, window, lambda: 3, adaptive=False) is False
+    assert _t.perf_counter() - t0 >= window * 0.95
+
+    # a resolving event short-circuits immediately in either mode
+    ev.set()
+    assert coalesce_wait(ev, window, lambda: 3, adaptive=True) is True
+    assert coalesce_wait(ev, 0.0, lambda: 3, adaptive=True) is True
+
+
+def test_farm_wait_adaptive_early_flush(chain):
+    """FarmBatcher.wait with the adaptive window flushes a plateaued
+    queue well before the fixed window elapses (and still resolves the
+    ticket correctly)."""
+    import time as _t
+    from cometbft_tpu.farm import planner
+
+    window = 0.4
+    cache = SigCache(65536)
+    b = FarmBatcher(cache=cache, coalesce_window_s=window, adaptive=True)
+    commit = chain.seen_commits[-1]
+    check = planner.plan_commit_light(
+        chain.chain_id, chain.valsets[-1], commit.block_id,
+        chain.max_height(), commit, cache)
+    ticket = b.submit(check)
+    t0 = _t.perf_counter()
+    b.wait([ticket])
+    dt = _t.perf_counter() - t0
+    assert ticket.ok()
+    assert dt < window * 0.9, \
+        f"adaptive wait took {dt:.3f}s, fixed window is {window}s"

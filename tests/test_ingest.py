@@ -1,0 +1,528 @@
+"""ingest/ — batched CheckTx admission pipeline (docs/INGEST.md).
+
+The load-bearing contract: batch admission is a VERDICT-EQUIVALENT
+drop-in for sequential check_tx — identical mempool contents and
+identical app-CheckTx call sequences for clean, bad-sig, duplicate,
+and recheck-evicted tx mixes, at depth 1 and depth N.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.ingest import (CODE_BAD_SIGNATURE, IngestPipeline,
+                                 IngestShed, MalformedTx,
+                                 make_signed_tx, native_backend,
+                                 parse_signed_tx, sign_bytes,
+                                 unwrap_payload)
+from cometbft_tpu.ingest.tx import MAGIC
+from cometbft_tpu.mempool.mempool import CListMempool, tx_key
+from cometbft_tpu.pipeline.cache import SigCache
+
+
+KEYS = [Ed25519PrivKey.generate(random.Random(1000 + i))
+        for i in range(4)]
+
+
+def _app():
+    """Recording app-CheckTx stub: code 0 for payloads containing '=',
+    1 otherwise, 2 for payloads whose key is in `banned`."""
+    calls = []
+    banned = set()
+
+    def check_fn(tx):
+        calls.append(tx)
+        payload = unwrap_payload(tx)
+        if b"=" not in payload:
+            return 1, 0
+        if payload.split(b"=", 1)[0] in banned:
+            return 2, 0
+        return 0, 1
+    return check_fn, calls, banned
+
+
+def _mk(batch=True, cache=None, **kw):
+    check_fn, calls, banned = _app()
+    mp = CListMempool(check_fn)
+    # NB: `cache or SigCache(...)` would be wrong — an empty SigCache
+    # defines __len__ and is falsy (the PR-5 SessionManager bug)
+    pipe = IngestPipeline(mp,
+                          cache=cache if cache is not None
+                          else SigCache(4096), batch=batch,
+                          coalesce_window_s=0.0,
+                          verify_backend=native_backend, **kw)
+    return pipe, mp, calls, banned
+
+
+def _mix(n=12, seed=7):
+    """Deterministic tx mix: clean signed, tampered sig, bare valid,
+    bare invalid, plus an interleaved duplicate of each clean tx."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        k = KEYS[i % len(KEYS)]
+        kind = ("good", "badsig", "bare", "bareinvalid")[i % 4]
+        if kind == "good":
+            out.append(("good", make_signed_tx(k, f"k{i}=v".encode())))
+        elif kind == "badsig":
+            tx = bytearray(make_signed_tx(k, f"b{i}=v".encode()))
+            tx[len(MAGIC) + 32] ^= 0x01  # first signature byte
+            out.append(("badsig", bytes(tx)))
+        elif kind == "bare":
+            out.append(("bare", f"bare{i}=v".encode()))
+        else:
+            out.append(("bareinvalid", f"noeq{i}".encode()))
+    # duplicates of every good tx, shuffled into the tail
+    dups = [("dup", tx) for kind, tx in out if kind == "good"]
+    rng.shuffle(dups)
+    return out + dups
+
+
+def _drive(pipe, txs, depth):
+    """Submit the mix, flushing every `depth` queued txs (depth=0 means
+    sequential mode: the pipeline applies inline). Returns per-tx
+    outcomes: ('code', n) | ('error', type-name)."""
+    outcomes = []
+    pending = []
+
+    def settle():
+        pipe.flush()
+        for t in pending:
+            assert t.done()
+            outcomes_by_id[id(t)] = (
+                ("error", type(t.error).__name__) if t.error is not None
+                else ("code", t.code))
+        pending.clear()
+
+    outcomes_by_id = {}
+    order = []
+    for kind, tx in txs:
+        try:
+            ticket = pipe.submit(tx)
+        except (IngestShed, ValueError) as e:
+            outcomes.append(("raised", type(e).__name__))
+            order.append(None)
+            continue
+        order.append(ticket)
+        outcomes.append(None)
+        if pipe.batch:
+            pending.append(ticket)
+            if len(pending) >= depth:
+                settle()
+    if pipe.batch:
+        settle()
+    for i, ticket in enumerate(order):
+        if ticket is not None:
+            if pipe.batch:
+                outcomes[i] = outcomes_by_id[id(ticket)]
+            else:
+                outcomes[i] = (("error", type(ticket.error).__name__)
+                               if ticket.error is not None
+                               else ("code", ticket.code))
+    return outcomes
+
+
+@pytest.mark.parametrize("depth", [1, 5, 100])
+def test_batch_vs_sequential_equivalence(depth):
+    """Identical mempool FIFO contents, identical app-CheckTx call
+    sequences, identical per-tx outcomes — batch at any depth vs the
+    sequential baseline."""
+    txs = _mix(16)
+    seq_pipe, seq_mp, seq_calls, _ = _mk(batch=False)
+    seq_out = _drive(seq_pipe, txs, 0)
+    bat_pipe, bat_mp, bat_calls, _ = _mk(batch=True)
+    bat_out = _drive(bat_pipe, txs, depth)
+    assert bat_out == seq_out
+    assert bat_calls == seq_calls  # app saw the SAME txs in the SAME order
+    assert [tx_key(t) for t in bat_mp.reap_max_txs(-1)] == \
+           [tx_key(t) for t in seq_mp.reap_max_txs(-1)]
+    # sanity: the mix actually exercised every class
+    classes = {o[0] for o in bat_out} | {o[1] for o in bat_out
+                                         if o[0] == "raised"}
+    assert "ValueError" in classes          # duplicates
+    assert ("code", CODE_BAD_SIGNATURE) in bat_out
+    assert ("code", 0) in bat_out
+
+
+def test_recheck_evicted_equivalence():
+    """Post-commit recheck evicts a now-invalid tx from the mempool AND
+    the ingest duplicate filter; resubmission re-admits through the
+    SigCache with no fresh signature lane — identically in batch and
+    sequential mode."""
+    results = {}
+    for mode in ("batch", "seq"):
+        pipe, mp, calls, banned = _mk(batch=(mode == "batch"))
+        txs = [make_signed_tx(KEYS[i % 4], f"r{i}=v".encode())
+               for i in range(6)]
+        tickets = [pipe.submit(tx) for tx in txs]
+        pipe.flush()
+        assert all(t.code == 0 for t in tickets)
+        assert mp.size() == 6
+        # commit the first two; poison r2 and r3 — recheck must evict
+        banned.update({b"r2", b"r3"})
+        mp.update(1, txs[:2])
+        assert mp.size() == 2  # r4, r5 survive
+        # evicted txs must be resubmittable (filter released) and ride
+        # the SigCache: zero new lanes in the resubmission batch
+        banned.clear()
+        width_before = pipe.batcher.batches
+        re_tickets = [pipe.submit(txs[2]), pipe.submit(txs[3])]
+        pipe.flush()
+        assert all(t.code == 0 for t in re_tickets)
+        if mode == "batch":
+            assert pipe.batcher.batches == width_before  # no lanes at all
+        # committed txs stay replay-blocked by the mempool cache
+        with pytest.raises(ValueError):
+            pipe.submit(txs[0])
+        pipe.flush()
+        results[mode] = ([tx_key(t) for t in mp.reap_max_txs(-1)], calls)
+    assert results["batch"] == results["seq"]
+
+
+def test_shed_and_filter_release():
+    pipe, mp, _, _ = _mk(max_pending=2)
+    t1 = pipe.submit(b"a=1")
+    t2 = pipe.submit(b"b=2")
+    with pytest.raises(IngestShed):
+        pipe.submit(b"c=3")
+    assert pipe.shed == 1
+    pipe.flush()
+    assert t1.code == 0 and t2.code == 0
+    # the shed released the filter entry: the retry is NOT a duplicate
+    t3 = pipe.submit(b"c=3")
+    pipe.flush()
+    assert t3.code == 0
+    assert mp.size() == 3
+
+
+def test_duplicate_filter_layers():
+    """Layer 1: the front tx-hash filter rejects in-flight duplicates
+    before any queue slot. Layer 2: a filter miss (LRU evicted) still
+    bounces off the mempool's own cache at apply time."""
+    pipe, mp, calls, _ = _mk(filter_size=1)
+    t1 = pipe.submit(b"a=1")
+    pipe.flush()
+    assert t1.code == 0 and len(calls) == 1
+    with pytest.raises(ValueError):
+        pipe.submit(b"a=1")  # front filter
+    pipe.submit(b"b=2")      # evicts a=1 from the 1-entry LRU filter
+    t3 = pipe.submit(b"a=1")  # filter misses now...
+    pipe.flush()
+    assert t3.error is not None  # ...but the mempool cache still holds it
+    assert "cache" in str(t3.error)
+    assert mp.size() == 2
+
+
+def test_malformed_envelope_rejected_before_app():
+    pipe, _, calls, _ = _mk()
+    with pytest.raises(MalformedTx):
+        pipe.submit(MAGIC + b"\x00" * 10)
+    pipe.flush()
+    assert calls == []  # never reached the app
+    # and the filter released it: resubmitting raises the same, not dup
+    with pytest.raises(MalformedTx):
+        pipe.submit(MAGIC + b"\x00" * 10)
+
+
+def test_bad_signature_never_reaches_app():
+    pipe, mp, calls, _ = _mk()
+    tx = bytearray(make_signed_tx(KEYS[0], b"x=1"))
+    tx[len(MAGIC) + 32] ^= 1
+    t = pipe.submit(bytes(tx))
+    pipe.flush()
+    assert t.code == CODE_BAD_SIGNATURE
+    assert calls == [] and mp.size() == 0
+    # failed signatures are never cached: resubmission re-verifies and
+    # fails identically (the filter released the key)
+    t2 = pipe.submit(bytes(tx))
+    pipe.flush()
+    assert t2.code == CODE_BAD_SIGNATURE
+
+
+def test_sigcache_dedup_across_submissions():
+    cache = SigCache(4096)
+    pipe, mp, _, _ = _mk(cache=cache)
+    tx = make_signed_tx(KEYS[0], b"c=1")
+    pipe.submit(tx)
+    assert pipe.flush() == 1  # one fresh lane
+    mp.flush()                # also resets the ingest filter (callback)
+    pipe.submit(tx)
+    assert pipe.flush() == 0  # SigCache hit: no lane dispatched
+    assert cache.hits.get("ingest", 0) == 1
+
+
+def test_wait_coalesces_and_flushes():
+    """A waiter whose window expires flushes everything pending —
+    including OTHER submitters' tickets."""
+    pipe, _, _, _ = _mk()
+    pipe.coalesce_window_s = 0.01
+    t1 = pipe.submit(make_signed_tx(KEYS[0], b"w1=1"))
+    t2 = pipe.submit(b"w2=2")
+    pipe.wait([t1])
+    assert t1.code == 0 and t2.code == 0
+
+
+def test_background_flusher_settles_nowait_intake():
+    pipe, mp, _, _ = _mk()
+    pipe.coalesce_window_s = 0.002
+    pipe.start()
+    try:
+        ticket = pipe.submit_nowait(make_signed_tx(KEYS[1], b"bg=1"))
+        assert ticket is not None
+        deadline = time.monotonic() + 5.0
+        while not ticket.done() and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert ticket.done() and ticket.code == 0
+        assert mp.size() == 1
+    finally:
+        pipe.stop()
+
+
+def test_concurrent_submitters_coalesce():
+    """Concurrent RPC-style submitters coalesce into shared batches and
+    ALL resolve; FIFO apply order matches submission order."""
+    pipe, mp, _, _ = _mk()
+    pipe.coalesce_window_s = 0.005
+    errs = []
+
+    def client(i):
+        try:
+            t = pipe.submit(make_signed_tx(KEYS[i % 4], f"t{i}=v".encode()))
+            pipe.wait([t])
+            assert t.code == 0
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(16)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(10.0)
+    assert not errs
+    assert mp.size() == 16
+    assert pipe.batcher.batches < 16  # actually coalesced
+
+
+def test_metrics_surface():
+    from cometbft_tpu.libs.metrics import Registry
+    from cometbft_tpu.libs.metrics_gen import IngestMetrics
+    reg = Registry()
+    m = IngestMetrics(reg)
+    check_fn, _, _ = _app()
+    mp = CListMempool(check_fn)
+    pipe = IngestPipeline(mp, cache=SigCache(64), batch=True,
+                          coalesce_window_s=0.0, max_pending=2,
+                          verify_backend=native_backend, metrics=m)
+    pipe.submit(make_signed_tx(KEYS[0], b"m=1"))
+    pipe.submit(b"noequals")
+    with pytest.raises(IngestShed):
+        pipe.submit(b"m2=2")
+    pipe.flush()
+    with pytest.raises(ValueError):
+        pipe.submit(make_signed_tx(KEYS[0], b"m=1"))
+    assert m.admitted.value() == 1
+    assert m.rejected.value(reason="app") == 1
+    assert m.shed.value() == 1
+    assert m.dedup_hits.value(kind="txhash") == 1
+    assert m.lanes.value(backend="cpu") == 1
+    assert m.queue_depth.value() == 0
+    exposed = reg.expose()
+    assert "ingest_admission_latency_seconds" in exposed
+
+
+# --- RPC front door -----------------------------------------------------------
+
+
+class _AppQuery:
+    def __init__(self, check_fn):
+        self._fn = check_fn
+
+    def check_tx(self, raw):
+        from cometbft_tpu.abci.application import CheckTxResult
+        code, gas = self._fn(raw)
+        return CheckTxResult(code=code, gas_wanted=gas)
+
+
+@pytest.fixture()
+def rpc_node():
+    from cometbft_tpu.rpc.client import RPCClient
+    from cometbft_tpu.rpc.server import RPCEnvironment, RPCServer
+    check_fn, calls, banned = _app()
+    mp = CListMempool(check_fn)
+    pipe = IngestPipeline(mp, cache=SigCache(4096), batch=True,
+                          coalesce_window_s=0.005,
+                          verify_backend=native_backend)
+    env = RPCEnvironment(chain_id="ingest-test", mempool=mp,
+                         app_query=_AppQuery(check_fn), ingest=pipe)
+    server = RPCServer(env, port=0)
+    server.start()
+    client = RPCClient("127.0.0.1", server.addr[1])
+    yield client, mp, pipe
+    server.stop()
+
+
+def test_rpc_broadcast_parks_on_batch(rpc_node):
+    client, mp, pipe = rpc_node
+    tx = make_signed_tx(KEYS[0], b"rpc=1")
+    r = client.broadcast_tx_sync(tx)
+    assert r["code"] == 0
+    assert mp.size() == 1
+    assert pipe.batcher.batches >= 1
+    # duplicate maps to the same -32603 surface as the sequential path
+    from cometbft_tpu.rpc.client import RPCClientError
+    with pytest.raises(RPCClientError, match="already in cache"):
+        client.broadcast_tx_sync(tx)
+    # bad signature: nonzero admission code in the RESULT, not an error
+    bad = bytearray(make_signed_tx(KEYS[0], b"rpc=2"))
+    bad[len(MAGIC) + 32] ^= 1
+    r = client.broadcast_tx_sync(bytes(bad))
+    assert r["code"] == CODE_BAD_SIGNATURE
+    assert mp.size() == 1
+
+
+def test_rpc_check_tx_cached_flag(rpc_node):
+    client, mp, pipe = rpc_node
+    tx = make_signed_tx(KEYS[1], b"q=1")
+    r1 = client.call("check_tx", tx=tx.hex())
+    assert r1["code"] == 0 and r1["cached"] is False
+    # second query: the signature verdict now rides the SigCache
+    r2 = client.call("check_tx", tx=tx.hex())
+    assert r2["code"] == 0 and r2["cached"] is True
+    # once admitted, the duplicate filter answers without the app
+    client.broadcast_tx_sync(tx)
+    r3 = client.call("check_tx", tx=tx.hex())
+    assert r3["cached"] is True
+    # tampered envelope: rejected without an app round trip
+    bad = bytearray(tx)
+    bad[len(MAGIC) + 32] ^= 1
+    r4 = client.call("check_tx", tx=bytes(bad).hex())
+    assert r4["code"] == CODE_BAD_SIGNATURE
+
+
+# --- envelope + app unwrap ----------------------------------------------------
+
+
+def test_envelope_roundtrip_and_kvstore_unwrap():
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.application import RequestFinalizeBlock
+    tx = make_signed_tx(KEYS[2], b"kv=42")
+    parsed = parse_signed_tx(tx)
+    assert parsed.payload == b"kv=42"
+    assert parsed.pub == KEYS[2].pub_key().bytes_()
+    assert KEYS[2].pub_key().verify_signature(
+        sign_bytes(parsed.payload), parsed.sig)
+    assert unwrap_payload(tx) == b"kv=42"
+    assert unwrap_payload(b"bare=1") == b"bare=1"
+    app = KVStoreApplication()
+    assert app.check_tx(tx).code == 0
+    resp = app.finalize_block(RequestFinalizeBlock(
+        txs=[tx], height=1, time=None, proposer_address=b"",
+        hash=b"", next_validators_hash=b""))
+    app.commit()
+    assert resp.tx_results[0].code == 0
+    assert app.state.get("kv") == "42"
+
+
+def test_mempool_reactor_routes_through_ingest():
+    from cometbft_tpu.mempool.reactor import MempoolReactor
+    pipe, mp, _, _ = _mk()
+    reactor = MempoolReactor(mp, ingest=pipe)
+    reactor.receive(0x30, None, make_signed_tx(KEYS[3], b"p2p=1"))
+    assert pipe.stats()["queued"] == 1
+    pipe.flush()
+    assert mp.size() == 1
+    # relayed garbage drops silently, never raises into the p2p loop
+    reactor.receive(0x30, None, MAGIC + b"\x00")
+    reactor.receive(0x30, None, make_signed_tx(KEYS[3], b"p2p=1"))
+
+
+# --- pubsub fan-out bound -----------------------------------------------------
+
+
+def test_pubsub_bounded_drop_oldest():
+    from cometbft_tpu.pubsub.pubsub import PubSubServer
+    from cometbft_tpu.pubsub.query import Query
+    srv = PubSubServer()
+    sub = srv.subscribe("slow", Query("tm.event = 'Tx'"), buffer=2)
+    for i in range(5):
+        srv.publish(i, {"tm.event": ["Tx"]})
+    assert sub.dropped == 3
+    got = [sub.next(0.1)[0] for _ in range(2)]
+    assert got == [3, 4]  # oldest dropped, newest kept
+
+
+# --- config / node knob -------------------------------------------------------
+
+
+def test_config_ingest_knob_roundtrip():
+    from cometbft_tpu.config import Config
+    cfg = Config()
+    assert cfg.mempool.ingest_batch is False
+    cfg.mempool.ingest_batch = True
+    cfg2 = Config.from_toml(cfg.to_toml())
+    assert cfg2.mempool.ingest_batch is True
+
+
+# --- live node end to end -----------------------------------------------------
+
+
+def test_node_ingest_batch_end_to_end(tmp_path):
+    """[mempool] ingest_batch on a LIVE single-validator node: a signed
+    envelope tx broadcast over JSON-RPC parks on its admission batch,
+    lands in a block, and the payload reaches the app's state — while
+    a tampered copy is refused at the front door without an app call."""
+    import os
+
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, ConsensusTimeoutsConfig
+    from cometbft_tpu.node.node import Node, save_genesis
+    from cometbft_tpu.privval.file import FilePV
+    from cometbft_tpu.rpc.client import RPCClient
+    from cometbft_tpu.state.state import GenesisDoc
+    from cometbft_tpu.types.proto import Timestamp
+    from cometbft_tpu.types.validator import Validator
+
+    pv = FilePV.generate(None)
+    gen = GenesisDoc(chain_id="ingest-net",
+                     genesis_time=Timestamp.now(),
+                     validators=[Validator(pv.get_pub_key(), 10)])
+    root = tmp_path / "ingestnode"
+    os.makedirs(root / "config", exist_ok=True)
+    cfg = Config(root_dir=str(root))
+    cfg.base.db_backend = "memdb"
+    cfg.mempool.ingest_batch = True
+    cfg.consensus = ConsensusTimeoutsConfig(
+        timeout_propose=500, timeout_propose_delta=250,
+        timeout_prevote=250, timeout_prevote_delta=150,
+        timeout_precommit=250, timeout_precommit_delta=150,
+        timeout_commit=50, wal_file="data/cs.wal")
+    save_genesis(gen, str(root / "config/genesis.json"))
+    app = KVStoreApplication()
+    node = Node(cfg, app, genesis=gen, priv_validator=pv)
+    assert node.ingest is not None
+    try:
+        node.start()
+        c = RPCClient(*node.rpc_server.addr)
+        key = Ed25519PrivKey.generate(random.Random(42))
+        tx = make_signed_tx(key, b"live=1")
+        r = c.broadcast_tx_sync(tx)
+        assert r["code"] == 0
+        bad = bytearray(make_signed_tx(key, b"live=2"))
+        bad[len(MAGIC) + 32] ^= 1
+        r2 = c.broadcast_tx_sync(bytes(bad))
+        assert r2["code"] == CODE_BAD_SIGNATURE
+        deadline = time.monotonic() + 60
+        while app.state.get("live") != "1":
+            assert time.monotonic() < deadline, "tx never committed"
+            time.sleep(0.05)
+        assert app.state.get("live") == "1"
+        assert "live" not in {k for k in app.state if k != "live"} or True
+        st = node.ingest.stats()
+        assert st["admitted"] >= 1
+        assert st["rejected"] >= 1
+    finally:
+        node.stop()

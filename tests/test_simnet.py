@@ -157,6 +157,33 @@ def test_light_farm_determinism():
     assert c.digest != a.digest
 
 
+def test_flash_crowd_scenario():
+    """The admission-crowd scenario: the bounded queue sheds and
+    clears, the duplicate filter hits, tampered signatures reject, and
+    the mempool FIFO matches the shadow-model replay (a violation
+    would fail r.ok)."""
+    r = run_scenario("flash-crowd", 1, quick=True)
+    assert r.ok, r.violations
+    assert r.stats["delivered"] > 100     # admitted txs
+    assert r.stats["blocked"] > 0         # queue-cap sheds fired
+    assert any(line.startswith("shed") for line in r.log_lines)
+    assert any(line.startswith("dup") for line in r.log_lines)
+    assert any(line.startswith("resubmit") for line in r.log_lines)
+    assert any("kind=badsig" in line for line in r.log_lines)
+
+
+def test_flash_crowd_determinism():
+    """Same seed => byte-identical admission event log (batch widths,
+    shed counts, every verdict)."""
+    a = run_scenario("flash-crowd", 4, quick=True)
+    b = run_scenario("flash-crowd", 4, quick=True)
+    assert a.ok, a.violations
+    assert a.digest == b.digest
+    assert a.log_lines == b.log_lines
+    c = run_scenario("flash-crowd", 5, quick=True)
+    assert c.digest != a.digest
+
+
 def test_seed_sweep_smoke():
     """Fast tier-1 sweep (<=20s CPU): one quick seed through each of
     the four headline fault classes. The full catalog runs in the

@@ -41,6 +41,13 @@ echo "=== light-farm quick sweep + farm A/B smoke ===" >&2
 python tools/sim_run.py --scenario light-farm --seeds 0..4 --quick || rc=$?
 python tools/bench_light.py --farm --clients 8 --blocks 12 \
     --validators 20 --json || rc=$?
+# ingest front door: the flash-crowd sweep pins overload behavior
+# (sheds, dup-filter hits, recheck-eviction release) byte-identical
+# per seed; the bench A/B proves batched admission still amortizes the
+# stub device round trip (tiny config — PERF.md has the full datum)
+echo "=== flash-crowd quick sweep + ingest A/B smoke ===" >&2
+python tools/sim_run.py --scenario flash-crowd --seeds 0..4 --quick || rc=$?
+python tools/bench_ingest.py --clients 64 --rounds 2 --json || rc=$?
 # suite 2/2 already covers the slow-marked pipeline soak on a default
 # (unfiltered) run; this explicit step guarantees the depth sweep even
 # when the caller filtered the main suites (e.g. -m 'not slow'), so no

@@ -208,13 +208,14 @@ class ReactorSleepRule(Rule):
     time (simnet) and the event loop alike — use the ticker /
     timesource seams or an event wait."""
     name = "reactor-sleep"
-    doc = ("time.sleep() in consensus//pipeline//engine//farm — use "
-           "the ticker seam, an Event wait, or the async form")
-    # farm/: RPC worker threads block on batcher Events; a raw sleep
-    # there would both stall coalescing and break the light-farm
-    # scenario's determinism
+    doc = ("time.sleep() in consensus//pipeline//engine//farm//ingest "
+           "— use the ticker seam, an Event wait, or the async form")
+    # farm/ and ingest/: RPC worker threads block on batcher/ticket
+    # Events; a raw sleep there would both stall coalescing and break
+    # the light-farm / flash-crowd scenarios' determinism
     roots = ("cometbft_tpu/consensus", "cometbft_tpu/pipeline",
-             "cometbft_tpu/engine", "cometbft_tpu/farm")
+             "cometbft_tpu/engine", "cometbft_tpu/farm",
+             "cometbft_tpu/ingest")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
@@ -396,13 +397,14 @@ class BareExceptRule(Rule):
     KeyboardInterrupt/SystemExit and masks wedge signatures the
     watchdog and supervisor key off — name the exceptions."""
     name = "bare-except"
-    doc = ("bare `except:` in device/, pipeline/, or farm/ — catch "
-           "named exception types so wedge/corruption signals "
+    doc = ("bare `except:` in device/, pipeline/, farm/, or ingest/ — "
+           "catch named exception types so wedge/corruption signals "
            "propagate")
-    # farm/ dispatches through the same device seam: a swallowed
-    # canary/transport signal would hide corruption from the supervisor
+    # farm/ and ingest/ dispatch through the same device seam: a
+    # swallowed canary/transport signal would hide corruption from the
+    # supervisor
     roots = ("cometbft_tpu/device", "cometbft_tpu/pipeline",
-             "cometbft_tpu/farm")
+             "cometbft_tpu/farm", "cometbft_tpu/ingest")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
