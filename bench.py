@@ -245,7 +245,10 @@ def _measure_mode(batch: int, iters: int) -> int:
     import jax
     dev = jax.devices()[0]
     _log(f"measure[{batch}]: devices: {jax.devices()}")
-    sigs_per_sec, _compile, which = measure(batch, iters)
+    from cometbft_tpu.libs.jax_cache import ledger
+    sigs_per_sec, compile_secs, which = measure(batch, iters)
+    warm_before = ledger().seen(f"rlc-{which}", batch)
+    ledger().record(f"rlc-{which}", batch, compile_secs)
     rec = {
         "metric": "ed25519_batch_verify_throughput",
         "value": round(sigs_per_sec, 1),
@@ -255,6 +258,12 @@ def _measure_mode(batch: int, iters: int) -> int:
         # which point-stage implementation produced the number — the
         # xla fallback must be distinguishable from a pallas result
         "kernel": which,
+        # compile-cache attribution (ledger keyed kernel|bucket):
+        # whether this (kernel, batch) was previously recorded warm,
+        # and what the compile actually cost this run
+        "compile_s": round(compile_secs, 2),
+        "compile_cache": {"seen_before": warm_before,
+                          **ledger().attribution()},
     }
     if dev.platform == "cpu":
         rec["backend"] = "cpu"
@@ -351,6 +360,152 @@ def _pipeline_mode() -> int:
     return 0
 
 
+def _aggsig_mode() -> int:
+    """`bench.py --aggsig`: 200-validator blocksync catch-up A/B —
+    ed25519 batch verification vs the BLS aggregate-commit fast path
+    (ROADMAP item 2, docs/AGGSIG.md).
+
+    Three measured sides over same-shape generated chains:
+      * ed25519: the existing native catch-up path (the production
+        baseline these chains run today);
+      * BLS aggregate: AggregatedCommit seals through the real
+        blocksync marshal/settle route — per commit the pairing work
+        is O(1) (two Miller loops + ONE final exponentiation when the
+        quorum is co-timed), read off crypto/bls12381.OP_COUNTERS;
+      * BLS per-signature: a measured sample of individual verifies,
+        projected to the full set — the O(n) reference the aggregate
+        replaces (2n Miller loops + n final exponentiations).
+
+    One-time costs are attributed separately: proof-of-possession
+    admission (amortized over each key's lifetime) and chain
+    generation. Emits ONE JSON line (kernel-bench schema) including
+    pairings-per-commit and the compile-cache ledger attribution.
+
+    Env knobs: BENCH_AGG_VALS (200), BENCH_AGG_BLOCKS (4),
+    BENCH_AGG_SAMPLE (4, per-sig sample size)."""
+    n_vals = int(os.environ.get("BENCH_AGG_VALS", "200"))
+    n_blocks = int(os.environ.get("BENCH_AGG_BLOCKS", "4"))
+    sample = max(1, min(int(os.environ.get("BENCH_AGG_SAMPLE", "4")),
+                        n_vals))
+
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.aggsig.aggregate import (register_pops_batch,
+                                               reset_pop_registry)
+    from cometbft_tpu.aggsig.verify import shared_finalexp
+    from cometbft_tpu.crypto.bls12381 import OP_COUNTERS
+    from cometbft_tpu.db.kv import MemDB
+    from cometbft_tpu.engine.blocksync import BlocksyncReactor
+    from cometbft_tpu.engine.chain_gen import (LocalChainSource,
+                                               generate_chain)
+    from cometbft_tpu.libs.jax_cache import ledger
+    from cometbft_tpu.pipeline.cache import reset_shared_cache
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State, StateStore
+    from cometbft_tpu.store.blockstore import BlockStore
+    from cometbft_tpu.types.agg_commit import AggregatedCommit
+
+    def catchup(chain) -> float:
+        app = KVStoreApplication()
+        app.init_chain(chain.chain_id, 1, [], b"")
+        db = MemDB()
+        store = BlockStore(db)
+        executor = BlockExecutor(app, state_store=StateStore(db),
+                                 block_store=store)
+        state = State.from_genesis(chain.genesis)
+        reactor = BlocksyncReactor(
+            executor, store, LocalChainSource(chain), chain.chain_id,
+            tile_size=8, batch_size=0)
+        reset_shared_cache()
+        t0 = time.perf_counter()
+        state = reactor.sync(state)
+        dt = time.perf_counter() - t0
+        assert state.last_block_height == chain.max_height()
+        return dt
+
+    _log(f"generating {n_blocks}-block ed25519 chain, "
+         f"{n_vals} validators...")
+    ed_chain = generate_chain(n_blocks=n_blocks, n_validators=n_vals,
+                              txs_per_block=1)
+    ed_s = catchup(ed_chain)
+    _log(f"ed25519 catch-up: {n_blocks * n_vals} sigs in {ed_s:.2f}s")
+
+    _log(f"generating {n_blocks}-block BLS chain (aggregated seals)...")
+    t0 = time.perf_counter()
+    bls_chain = generate_chain(
+        n_blocks=n_blocks, n_validators=n_vals, txs_per_block=1,
+        key_type="bls12_381", aggregate=True)
+    gen_s = time.perf_counter() - t0
+    for c in bls_chain.seen_commits:
+        assert isinstance(c, AggregatedCommit)
+
+    # one-time PoP admission cost (batched RLC multi-pairing),
+    # measured against a cleared registry
+    reset_pop_registry()
+    t0 = time.perf_counter()
+    assert register_pops_batch(bls_chain.genesis.bls_pops)
+    pop_s = time.perf_counter() - t0
+    _log(f"PoP admission: {n_vals} keys in {pop_s:.2f}s "
+         f"({pop_s / n_vals * 1000:.0f} ms/key, one-time)")
+
+    c0 = dict(OP_COUNTERS)
+    agg_s = catchup(bls_chain)
+    millers = OP_COUNTERS["miller_loops"] - c0["miller_loops"]
+    fexps = OP_COUNTERS["final_exps"] - c0["final_exps"]
+    _log(f"BLS aggregate catch-up: {n_blocks} commits "
+         f"({n_vals} signers each) in {agg_s:.2f}s — "
+         f"{millers} Miller loops, {fexps} final exps total")
+
+    # per-signature BLS reference, measured on a sample
+    from cometbft_tpu.types.vote import Vote, PRECOMMIT_TYPE
+    from cometbft_tpu.types.proto import Timestamp
+    vals0 = bls_chain.valsets[0]
+    t0 = time.perf_counter()
+    checked = 0
+    for i in range(sample):
+        val = vals0.validators[i]
+        key = bls_chain.keys[val.address]
+        vote = Vote(type_=PRECOMMIT_TYPE, height=1, round=0,
+                    block_id=bls_chain.block_ids[0],
+                    timestamp=Timestamp(1_700_000_001, 1_000_000 + i),
+                    validator_address=val.address, validator_index=i)
+        sig = key.sign(vote.sign_bytes(bls_chain.chain_id))
+        t_sig = time.perf_counter()
+        assert val.pub_key.verify_signature(
+            vote.sign_bytes(bls_chain.chain_id), sig)
+        checked += 1
+        del t_sig
+    per_sig_s = (time.perf_counter() - t0) / checked
+    projected_commit_s = per_sig_s * n_vals
+
+    agg_commit_s = agg_s / n_blocks
+    rec = {
+        "metric": "aggsig_catchup_commit_verify",
+        "value": round(agg_commit_s, 3),
+        "unit": "s/commit",
+        "vs_baseline": round(projected_commit_s / agg_commit_s, 1),
+        "backend": shared_finalexp().backend,
+        "validators": n_vals,
+        "blocks": n_blocks,
+        "pairings_per_commit": {
+            "aggregate_miller_loops": round(millers / n_blocks, 2),
+            "aggregate_final_exps": round(fexps / n_blocks, 2),
+            "per_sig_miller_loops": 2 * n_vals,
+            "per_sig_final_exps": n_vals,
+        },
+        "bls_aggregate_catchup_s": round(agg_s, 3),
+        "bls_per_sig_s_measured": round(per_sig_s, 3),
+        "bls_per_sig_commit_s_projected": round(projected_commit_s, 1),
+        "speedup_vs_per_sig": round(projected_commit_s / agg_commit_s, 1),
+        "ed25519_catchup_s": round(ed_s, 3),
+        "ed25519_sigs_per_sec": round(n_blocks * n_vals / ed_s, 1),
+        "pop_admission_s_total": round(pop_s, 2),
+        "chain_gen_s": round(gen_s, 2),
+        "compile_cache": ledger().attribution(),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "8192"))
     iters = int(os.environ.get("BENCH_ITERS", "4"))
@@ -406,11 +561,20 @@ def main():
         kernels = ["pallas", "xla"]
     deadline = time.monotonic() + float(
         os.environ.get("BENCH_TOTAL_TIMEOUT", "4500"))
+    from cometbft_tpu.libs.jax_cache import ledger
     for b in attempts:
         for which in kernels:
             if time.monotonic() > deadline:
                 _log("total bench budget exhausted")
                 return 1
+            if ledger().known_crash(f"rlc-{which}", b):
+                # the compile ledger remembers this (kernel, bucket)
+                # killed the compiler on this platform/jax build —
+                # skip straight to the next shape instead of paying
+                # the crash again (ROADMAP item-5 residual)
+                _log(f"skip batch={b} kernel={which}: ledger marks it "
+                     f"compiler-fatal on this platform")
+                continue
             _log(f"measuring batch={b} kernel={which} in a subprocess "
                  f"(timeout {measure_timeout:.0f}s)...")
             try:
@@ -441,6 +605,11 @@ def main():
                     line = json.dumps(rec)
                 print(line, flush=True)
                 return 0
+            if r.returncode < 0:
+                # compiler crash (SIGSEGV et al): remember the bucket
+                # so future rounds skip it without re-crashing
+                ledger().record_crash(f"rlc-{which}", b,
+                                      f"signal {-r.returncode}")
             _log(f"measure[{b},{which}] failed rc={r.returncode} "
                  f"(signal="
                  f"{-r.returncode if r.returncode < 0 else 'none'}); "
@@ -454,4 +623,6 @@ if __name__ == "__main__":
         sys.exit(_measure_mode(int(sys.argv[2]), int(sys.argv[3])))
     if len(sys.argv) > 1 and sys.argv[1] == "--pipeline":
         sys.exit(_pipeline_mode())
+    if len(sys.argv) > 1 and sys.argv[1] == "--aggsig":
+        sys.exit(_aggsig_mode())
     sys.exit(main())

@@ -1,0 +1,30 @@
+"""aggsig/ — BLS12-381 aggregate-commit fast path.
+
+Turns the n per-validator precommit verifications of a commit into ONE
+multi-pairing check (shared Miller loops, a single final
+exponentiation) when the validator set is uniformly BLS — the trade
+quantified by PAPERS.md's lead paper (EdDSA vs BLS in committee-based
+consensus, arXiv 2302.00418) and ROADMAP item 2.
+
+Layout:
+  aggregate.py — G2 signature aggregation, the signer-bitmap codec,
+                 proof-of-possession (rogue-key defense) and its
+                 process registry, and the BlsBatchVerifier plugged
+                 into crypto/batch's dispatch seam.
+  verify.py    — aggregated-commit verification (one pairing equation
+                 per commit), the batched final-exponentiation backend
+                 (ops/bls12 kernel on device platforms, native CPU
+                 fallback, canary-lane gated per the PR-3 discipline),
+                 and the SigCache keying of whole-aggregate verdicts.
+
+The AggregatedCommit seal itself lives in types/agg_commit.py (wire
+format beside the other consensus types); docs/AGGSIG.md documents the
+format, the PoP policy, and the knobs.
+"""
+
+from .aggregate import (  # noqa: F401
+    BlsBatchVerifier, aggregate_signatures, bitmap_decode, bitmap_encode,
+    has_pop, pop_prove, pop_verify, register_pop, reset_pop_registry,
+    valset_pops_ok)
+from .verify import (  # noqa: F401
+    AggregateVerificationError, shared_finalexp, verify_aggregated_commit)

@@ -1,0 +1,369 @@
+"""Aggregated-commit verification: one pairing equation per commit.
+
+Per commit the check is
+
+    e(-g1, S_agg) · Π_j e(Σ_{i∈group_j} pk_i, H(m_j)) == 1
+
+where groups collect covered signers by identical sign-bytes (the
+canonical precommit message differs only in the per-validator
+timestamp, so commits whose precommits share timestamps — BFT time
+under a virtual clock, or any co-timed quorum — collapse to a single
+group and the whole commit costs TWO Miller loops and ONE final
+exponentiation, independent of validator-set size).
+
+The final exponentiation — the dominant shared cost — is routed
+through a FinalExpChecker so many commits verify together during
+blocksync: the host computes each commit's Miller product, the checker
+batches the `final_exp(m) == 1` verdicts on the ops/bls12 JAX kernel
+when a device platform is configured, with a native CPU fallback and
+the PR-3 canary discipline (a known-one and a known-not-one element
+spliced into every kernel batch; any canary mismatch quarantines the
+kernel for the process, re-verifies the batch on CPU, and reports to a
+DeviceSupervisor when one is attached — a wrong kernel verdict can
+never reach commit verification).
+
+Whole-aggregate verdicts are SigCache-keyed (path="aggsig"): the
+triple (b"aggsig|" + valset-hash, seal-digest, agg_sig) makes a hit
+exactly "this aggregate already verified TRUE against this validator
+set on this chain". Nil-vote lanes keep individual signatures and
+verify per-signature with their own cache entries.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..crypto import bls12381 as bls
+from ..libs.env import env_bool
+from ..types.validation import (CommitVerificationError,
+                                ErrNotEnoughVotingPowerSigned,
+                                ErrWrongSignature)
+from .aggregate import has_pop
+
+ENV_KERNEL = "COMETBFT_TPU_AGGSIG_KERNEL"
+
+# Aggregate-path tallies for bench attribution (bench.py --aggsig
+# diffs these around a run; bls.OP_COUNTERS carries the raw
+# miller/final-exp counts). Counts only, never logged from
+# deterministic paths.
+AGG_COUNTERS = {"aggregates_cpu": 0, "aggregates_kernel": 0,
+                "pop_rejections": 0, "cache_hits": 0}
+
+_metrics = None  # libs/metrics_gen.AggsigMetrics, wired by node boot/bench
+
+
+def set_metrics(m) -> None:
+    global _metrics
+    _metrics = m
+
+
+class AggregateVerificationError(CommitVerificationError):
+    """The aggregate itself failed (bad pairing / signer without PoP /
+    malformed seal) — distinct from power/structure errors so callers
+    can attribute rejections."""
+
+
+# --- batched final-exponentiation checker -------------------------------------
+
+class FinalExpChecker:
+    """Batched `final_exponentiation(m) == 1` verdicts.
+
+    backend="cpu": the native Frobenius-split final exponentiation per
+    element. backend="kernel": the ops/bls12 batched hard-part pow (the
+    easy part is host-side — one inversion plus Frobenius maps per
+    element), canary-gated: every kernel batch carries a known-one and
+    a known-not-one element; a wrong canary verdict quarantines the
+    kernel permanently for this checker, re-verifies the whole batch on
+    CPU, and reports corruption to the attached supervisor."""
+
+    def __init__(self, backend: str = "cpu", supervisor=None):
+        if backend not in ("cpu", "kernel"):
+            raise ValueError(f"unknown finalexp backend {backend!r}")
+        self.backend = backend
+        self.supervisor = supervisor
+        self.quarantined = False
+        self.canary_failures = 0
+        self._canaries = None
+
+    def _canary_pair(self):
+        """(known-one, known-not-one) Miller products, computed once:
+        miller(-g1,Q)·miller(g1,Q) final-exponentiates to exactly 1;
+        miller(g1,Q) alone final-exponentiates to e(g1,Q) != 1 by
+        pairing non-degeneracy."""
+        if self._canaries is None:
+            q = bls.G2_GEN
+            good = bls.miller_product([(bls.G1_NEG, q), (bls.G1_GEN, q)])
+            bad = bls.miller_loop(bls.G1_GEN, q)
+            self._canaries = (good, bad)
+        return self._canaries
+
+    @staticmethod
+    def _cpu_check(elems: Sequence) -> List[bool]:
+        return [bls.final_exponentiation(m) == bls.F12_ONE for m in elems]
+
+    def check(self, elems: Sequence) -> List[bool]:
+        if not elems:
+            return []
+        if self.backend == "kernel" and not self.quarantined:
+            try:
+                return self._kernel_check(elems)
+            except Exception as exc:  # noqa: BLE001 — any kernel
+                # failure (import, compile, runtime) degrades to the
+                # native path; the supervisor hears about transport-ish
+                # errors so probe/backoff applies
+                if self.supervisor is not None:
+                    self.supervisor.report_trip(exc)
+                self.quarantined = True
+        out = self._cpu_check(elems)
+        AGG_COUNTERS["aggregates_cpu"] += len(elems)
+        if _metrics is not None:
+            _metrics.aggregates_verified.inc(len(elems), backend="cpu")
+        return out
+
+    def _kernel_check(self, elems: Sequence) -> List[bool]:
+        from ..ops import bls12 as kernel
+        good, bad = self._canary_pair()
+        batch = list(elems) + [good, bad]
+        verdicts = kernel.final_exp_is_one_batch(batch)
+        if len(verdicts) != len(batch) or not verdicts[-2] or verdicts[-1]:
+            # canary answered wrong (or the lane count drifted):
+            # quarantine and recompute everything on the CPU oracle
+            self.canary_failures += 1
+            self.quarantined = True
+            if self.supervisor is not None:
+                self.supervisor.report_corruption("bls finalexp canary")
+            if _metrics is not None:
+                _metrics.canary_failures.inc()
+            out = self._cpu_check(elems)
+            AGG_COUNTERS["aggregates_cpu"] += len(elems)
+            if _metrics is not None:
+                _metrics.aggregates_verified.inc(len(elems), backend="cpu")
+            return out
+        AGG_COUNTERS["aggregates_kernel"] += len(elems)
+        if _metrics is not None:
+            _metrics.aggregates_verified.inc(len(elems), backend="kernel")
+        return [bool(v) for v in verdicts[:-2]]
+
+
+_shared_checker: Optional[FinalExpChecker] = None
+_shared_lock = threading.Lock()
+
+
+def shared_finalexp() -> FinalExpChecker:
+    """Process-wide checker. The kernel backend is opt-in: a real
+    device platform, or COMETBFT_TPU_AGGSIG_KERNEL=1 — XLA:CPU pays a
+    multi-minute compile for the pow scan, the exact hazard the
+    compile-cache ledger exists to attribute (libs/jax_cache)."""
+    global _shared_checker
+    with _shared_lock:
+        if _shared_checker is None:
+            from ..libs.jax_cache import is_device_platform
+            use_kernel = (is_device_platform()
+                          or env_bool(ENV_KERNEL, False))
+            _shared_checker = FinalExpChecker(
+                "kernel" if use_kernel else "cpu")
+        return _shared_checker
+
+
+def reset_shared_finalexp() -> None:
+    global _shared_checker
+    with _shared_lock:
+        _shared_checker = None
+
+
+# --- commit verification ------------------------------------------------------
+
+def _count_pairings(n: int) -> None:
+    if _metrics is not None:
+        _metrics.pairings_total.inc(n)
+
+
+def _prepare(chain_id: str, vals, commit, voting_power_needed: int,
+             ignore, count, lookup_by_index: bool, cache):
+    """Shared body: returns ("ok", None) on a cache hit, ("fail", exc)
+    on any decided rejection, or ("pend", (miller_product, cache_key))
+    when only the final exponentiation is outstanding."""
+    try:
+        commit.validate_basic()
+        covered = commit.covered_indices()
+    except ValueError as e:
+        return "fail", CommitVerificationError(
+            f"malformed aggregated commit: {e}")
+
+    covered_set = set(covered)
+    tallied = 0
+    seen: Dict[int, int] = {}
+    entries: List[Tuple[int, object]] = []     # covered (idx, validator)
+    nil_checks: List[Tuple[int, object, object]] = []
+    for idx, cs in enumerate(commit.signatures):
+        is_cov = idx in covered_set
+        if not is_cov and ignore(cs):
+            continue
+        if lookup_by_index:
+            val = vals.get_by_index(idx)
+            if val is None:
+                return "fail", CommitVerificationError(
+                    f"no validator at index {idx}")
+        else:
+            val_idx, val = vals.get_by_address(cs.validator_address)
+            if val is None:
+                if is_cov:
+                    # an unknown signer's key cannot be subtracted from
+                    # the aggregate: the trusting form requires every
+                    # covered signer known to the trusted set
+                    # (docs/AGGSIG.md)
+                    return "fail", CommitVerificationError(
+                        f"aggregate signer at index {idx} unknown to "
+                        f"trusted validator set")
+                continue
+            if val_idx in seen:
+                return "fail", CommitVerificationError(
+                    f"double vote from validator {val_idx} "
+                    f"({seen[val_idx]} and {idx})")
+            seen[val_idx] = idx
+        if is_cov:
+            if val.pub_key.type_() != bls.KEY_TYPE:
+                return "fail", CommitVerificationError(
+                    f"aggregate signer at index {idx} is not a BLS key")
+            entries.append((idx, val))
+        elif not cs.absent_():
+            nil_checks.append((idx, val, cs))
+        if count(cs):
+            tallied += val.voting_power
+
+    if tallied <= voting_power_needed:
+        return "fail", ErrNotEnoughVotingPowerSigned(
+            tallied, voting_power_needed)
+
+    for idx, val in entries:
+        if not has_pop(val.pub_key.bytes_()):
+            AGG_COUNTERS["pop_rejections"] += 1
+            if _metrics is not None:
+                _metrics.pop_rejections.inc()
+            return "fail", AggregateVerificationError(
+                f"aggregate signer at index {idx} has no registered "
+                f"proof of possession")
+
+    vh = vals.hash()
+    cache_key = (b"aggsig|" + vh,
+                 commit.seal_digest(chain_id, vh), commit.agg_sig)
+    if cache is not None and cache.seen(*cache_key, path="aggsig"):
+        AGG_COUNTERS["cache_hits"] += 1
+        return "ok", None
+
+    # nil-vote lanes: individual signatures, individually cached
+    for idx, val, cs in nil_checks:
+        msg = commit.vote_sign_bytes(chain_id, idx)
+        pkb = val.pub_key.bytes_()
+        if cache is not None and cache.seen(pkb, msg, cs.signature,
+                                            path="aggsig"):
+            continue
+        if not val.pub_key.verify_signature(msg, cs.signature):
+            return "fail", ErrWrongSignature(idx, cs.signature)
+        if cache is not None:
+            cache.add(pkb, msg, cs.signature)
+
+    try:
+        s_agg = bls.g2_decompress(commit.agg_sig)
+    except ValueError:
+        s_agg = None
+    if s_agg is None:
+        return "fail", AggregateVerificationError(
+            "aggregate signature is not a valid G2 point")
+
+    groups: Dict[bytes, object] = {}
+    for idx, val in entries:
+        fixed = bls._fixed_msg(commit.vote_sign_bytes(chain_id, idx))
+        pt = val.pub_key.point
+        prev = groups.get(fixed)
+        groups[fixed] = pt if prev is None else bls._fq.pt_add(prev, pt)
+
+    pairs = [(bls.G1_NEG, s_agg)]
+    for fixed, pk_sum in groups.items():
+        pairs.append((pk_sum, bls.hash_to_g2_cached(fixed)))
+    _count_pairings(len(pairs))
+    return "pend", (bls.miller_product(pairs), cache_key)
+
+
+def verify_aggregated_commit(chain_id: str, vals, commit,
+                             voting_power_needed: int, *,
+                             ignore, count, count_all: bool,
+                             lookup_by_index: bool,
+                             cache=None, checker=None) -> None:
+    """The AggregatedCommit analog of validation._verify_commit_core:
+    same ignore/count callbacks, same exception vocabulary, one
+    multi-pairing instead of n signature checks. count_all is accepted
+    for signature parity; the aggregate is a single check, so there is
+    no early-exit variant to pick."""
+    del count_all
+    status, payload = _prepare(chain_id, vals, commit,
+                               voting_power_needed, ignore, count,
+                               lookup_by_index, cache)
+    if status == "fail":
+        raise payload
+    if status == "ok":
+        return
+    product, cache_key = payload
+    ok = (checker or shared_finalexp()).check([product])[0]
+    if not ok:
+        raise AggregateVerificationError(
+            "aggregate signature does not verify against the signer "
+            "bitmap")
+    if cache is not None:
+        cache.add(*cache_key)
+
+
+class AggSeal:
+    """A marshaled aggregate-commit check: either already decided
+    ("ok"/"fail") or pending only its final exponentiation ("pend",
+    payload = (miller_product, cache_key)). The blocksync marshal
+    stage produces these so settle_tile can batch many commits' final
+    exponentiations through one FinalExpChecker call."""
+
+    __slots__ = ("status", "payload")
+
+    def __init__(self, status: str, payload):
+        self.status = status
+        self.payload = payload
+
+
+def prepare_full_commit(chain_id: str, vals, commit, needed: int,
+                        cache=None) -> AggSeal:
+    """FULL verify_commit semantics (absent ignored, every included
+    signature checked, for-block power > 2/3) marshaled into an
+    AggSeal — the aggregate analog of blocksync's lane marshal."""
+    status, payload = _prepare(
+        chain_id, vals, commit, needed,
+        ignore=lambda c: c.absent_(),
+        count=lambda c: c.for_block(),
+        lookup_by_index=True, cache=cache)
+    return AggSeal(status, payload)
+
+
+def settle_seals(seals: Sequence[AggSeal], cache=None,
+                 checker=None) -> List[bool]:
+    """Resolve marshaled seals to verdicts, batching every pending
+    final exponentiation through one checker call; verified-TRUE
+    aggregates feed the cache."""
+    pend = [i for i, s in enumerate(seals) if s.status == "pend"]
+    verdicts = [s.status == "ok" for s in seals]
+    if pend:
+        oks = (checker or shared_finalexp()).check(
+            [seals[i].payload[0] for i in pend])
+        for i, ok in zip(pend, oks):
+            verdicts[i] = bool(ok)
+            if ok and cache is not None:
+                cache.add(*seals[i].payload[1])
+    return verdicts
+
+
+def verify_aggregated_commits_bulk(chain_id: str, items, cache=None,
+                                   checker=None) -> List[bool]:
+    """Blocksync form: many (vals, commit, voting_power_needed)
+    triples verified with FULL verify_commit semantics and their final
+    exponentiations batched through one checker call. Returns per-item
+    verdicts (True/False), never raises per-item errors."""
+    seals = [prepare_full_commit(chain_id, vals, commit, needed, cache)
+             for vals, commit, needed in items]
+    return settle_seals(seals, cache=cache, checker=checker)

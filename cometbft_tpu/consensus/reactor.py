@@ -141,7 +141,13 @@ def votes_from_commit(commit: Commit) -> List[Vote]:
     """Reconstruct the signed precommits a Commit attests to (the
     reference's VoteSet-from-commit path, types/vote_set.go
     CommitToVoteSet) — what a lagging peer needs to cross its 2/3
-    threshold for an already-decided height."""
+    threshold for an already-decided height. An AggregatedCommit holds
+    no per-lane signatures to reconstruct (callers serve Maj23 + block
+    parts instead, the same posture as the extensions carve-out
+    below)."""
+    from ..types.agg_commit import AggregatedCommit
+    if isinstance(commit, AggregatedCommit):
+        return []
     votes = []
     for idx, cs in enumerate(commit.signatures):
         if cs.absent_():

@@ -26,13 +26,21 @@ def create_batch_verifier(pk: PubKey) -> Tuple[Optional[BatchVerifier], bool]:
     if pk.type_() == "sr25519":
         from .sr25519 import Sr25519BatchVerifier
         return Sr25519BatchVerifier(), True
+    if pk.type_() == "bls12_381":
+        # one multi-pairing (random-linear-combination) over the whole
+        # batch with a single shared final exponentiation, per-sig
+        # fallback for attribution — so MixedBatchVerifier handles
+        # mixed-curve vote sets instead of silently going per-sig
+        from ..aggsig.aggregate import BlsBatchVerifier
+        return BlsBatchVerifier(), True
     return None, False
 
 
 def supports_batch_verifier(pk: PubKey) -> bool:
     """reference crypto/batch/batch.go:25-35 (secp256k1 has no batch
     form, exactly like the reference — callers fall back to per-sig)."""
-    return pk is not None and pk.type_() in (ED25519_KEY_TYPE, "sr25519")
+    return pk is not None and pk.type_() in (ED25519_KEY_TYPE, "sr25519",
+                                             "bls12_381")
 
 
 class MixedBatchVerifier:

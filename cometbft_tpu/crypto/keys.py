@@ -187,6 +187,18 @@ class Ed25519BatchVerifier:
         return all(oks), oks
 
 
+def privkey_from_type_bytes(key_type: str, raw: bytes) -> PrivKey:
+    """Private-key factory by wire type string — the decode side of
+    FilePV state files, which persist (type, raw) so a BLS validator
+    key round-trips as BLS instead of being re-typed ed25519."""
+    if key_type == ED25519_KEY_TYPE:
+        return Ed25519PrivKey(raw)
+    if key_type == "bls12_381":
+        from .bls12381 import Bls12381PrivKey
+        return Bls12381PrivKey(raw)
+    raise ValueError(f"unsupported privval key type {key_type!r}")
+
+
 def pubkey_from_type_bytes(key_type: str, raw: bytes) -> PubKey:
     """Key factory by wire type string (reference
     crypto/encoding/codec.go:119 PubKeyFromTypeAndBytes)."""

@@ -83,8 +83,16 @@ class DeviceServer:
         # that without the fallback, which then first compiled minutes
         # into a live commit verification.)
         bad = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
-        verify_batch([pub], [b"warm"], [sig], batch_size=self.bucket)
-        verify_batch([pub], [b"warm"], [bad], batch_size=self.bucket)
+        # compile-ledger attribution (ROADMAP item-5 residual): the
+        # warm cost is keyed (kernel, bucket) so later server/bench
+        # runs can predict a warm reload vs a multi-minute cold
+        # compile, and a compiler crash marks the bucket bad instead
+        # of being rediscovered next round
+        from ..libs.jax_cache import ledger
+        with ledger().compile_guard("ed25519-rlc", self.bucket):
+            verify_batch([pub], [b"warm"], [sig], batch_size=self.bucket)
+        with ledger().compile_guard("ed25519-rlc-fallback", self.bucket):
+            verify_batch([pub], [b"warm"], [bad], batch_size=self.bucket)
 
     def _flush(self, jobs: List[_Job]) -> None:
         from ..ops.ed25519 import verify_batch

@@ -75,6 +75,30 @@ def marshal_commit(chain_id: str, e: TileEntry, pubs: List[bytes],
     if commit.height != e.height or commit.block_id != e.block_id:
         return e, None, 0
     needed = vals.total_voting_power() * 2 // 3
+    from ..types.agg_commit import AggregatedCommit
+    if isinstance(commit, AggregatedCommit):
+        # BLS aggregate seal: the whole-commit check is marshaled here
+        # (structure, tally, PoP gate, Miller product — all host work,
+        # exactly this stage's job) and only the final exponentiation
+        # is left for settle_tile, which batches it across the tile
+        from ..aggsig.verify import prepare_full_commit
+        return e, prepare_full_commit(chain_id, vals, commit, needed,
+                                      cache=cache), needed
+    if any(v.pub_key.type_() != "ed25519" for v in vals.validators):
+        # plain per-lane commit on a non-ed25519 (or mixed) valset:
+        # the flat lanes below feed the ed25519 kernel, which rejects
+        # every foreign-curve signature. Verify host-side with full
+        # semantics through the generic dispatch seam instead —
+        # verifiers must accept either commit form for BLS valsets
+        # (docs/AGGSIG.md), and the verdict is already decided by
+        # settle time (AggSeal "ok"/"fail", no pending work).
+        from ..aggsig.verify import AggSeal
+        try:
+            validation.verify_commit(chain_id, vals, e.block_id,
+                                     e.height, commit)
+            return e, AggSeal("ok", None), needed
+        except validation.CommitVerificationError:
+            return e, AggSeal("fail", None), needed
     rows = []
     for idx, cs in enumerate(commit.signatures):
         if cs.absent_():
@@ -131,8 +155,19 @@ def verify_lanes(pubs: Sequence[bytes], msgs: Sequence[bytes],
 def settle_tile(metas, out, pubs, msgs, sigs, cache=None) -> None:
     """Map per-lane verdicts back to per-commit results with FULL
     verify_commit semantics (every included signature valid AND for-block
-    power > 2/3); newly verified-true lanes feed the cache."""
+    power > 2/3); newly verified-true lanes feed the cache. Aggregated
+    commits arrive as marshaled AggSeals and settle in ONE batched
+    final-exponentiation call for the whole tile."""
+    from ..aggsig.verify import AggSeal, settle_seals
+    agg = [(e, rows) for e, rows, _n in metas
+           if isinstance(rows, AggSeal)]
+    if agg:
+        for (e, _s), ok in zip(agg, settle_seals([s for _e, s in agg],
+                                                 cache=cache)):
+            e.commit_ok = ok
     for e, rows, needed in metas:
+        if isinstance(rows, AggSeal):
+            continue
         if rows is None:  # structural failure already decided
             e.commit_ok = False
             continue

@@ -39,26 +39,41 @@ class GeneratedChain:
 
 
 def make_genesis(n_validators: int, chain_id: str = "tpu-chain",
-                 seed: int = 1, power: Optional[List[int]] = None
+                 seed: int = 1, power: Optional[List[int]] = None,
+                 key_type: str = "ed25519"
                  ) -> Tuple[GenesisDoc, Dict[bytes, Ed25519PrivKey]]:
     rng = random.Random(seed)
-    keys = [Ed25519PrivKey(bytes(rng.randrange(256) for _ in range(32)))
-            for _ in range(n_validators)]
+    pops = {}
+    if key_type == "bls12_381":
+        # genesis proofs of possession: verified + registered by
+        # State.from_genesis, admitting the keys to aggregation
+        from ..aggsig.aggregate import deterministic_keys_with_pops
+        keys, pops = deterministic_keys_with_pops(n_validators, rng)
+    else:
+        keys = [Ed25519PrivKey(bytes(rng.randrange(256)
+                                     for _ in range(32)))
+                for _ in range(n_validators)]
     vals = [Validator(k.pub_key(), power[i] if power else 10)
             for i, k in enumerate(keys)]
     gen = GenesisDoc(chain_id=chain_id, validators=vals,
-                     genesis_time=Timestamp(1_700_000_000, 0))
+                     genesis_time=Timestamp(1_700_000_000, 0),
+                     bls_pops=pops)
     return gen, {k.pub_key().address(): k for k in keys}
 
 
 def sign_commit(chain_id: str, height: int, round_: int, block_id: BlockID,
                 valset, keys: Dict[bytes, Ed25519PrivKey],
-                base_time: int = 1_700_000_000) -> Commit:
+                base_time: int = 1_700_000_000,
+                uniform_ts: bool = False) -> Commit:
     """All validators precommit for the block (reference
-    types/vote_set.go MakeExtendedCommit path, minus extensions)."""
+    types/vote_set.go MakeExtendedCommit path, minus extensions).
+    uniform_ts stamps every precommit with the same timestamp — all
+    signers then share ONE canonical message, the shape that collapses
+    an aggregated commit to a single pairing group (a co-timed quorum;
+    BFT time under a virtual clock behaves the same way)."""
     sigs = []
     for i, val in enumerate(valset.validators):
-        ts = Timestamp(base_time + height, i)
+        ts = Timestamp(base_time + height, 0 if uniform_ts else i)
         vote = Vote(type_=PRECOMMIT_TYPE, height=height, round=round_,
                     block_id=block_id, timestamp=ts,
                     validator_address=val.address, validator_index=i)
@@ -74,13 +89,18 @@ def generate_chain(n_blocks: int, n_validators: int = 4,
                    app_factory: Callable[[], Application] = KVStoreApplication,
                    txs_per_block: int = 2,
                    val_tx_heights: Optional[Dict[int, bytes]] = None,
-                   extra_keys: Optional[List[Ed25519PrivKey]] = None
-                   ) -> GeneratedChain:
+                   extra_keys: Optional[List[Ed25519PrivKey]] = None,
+                   key_type: str = "ed25519",
+                   aggregate: bool = False) -> GeneratedChain:
     """Build a valid chain by executing blocks through the real
     BlockExecutor. `val_tx_heights` maps height -> raw val-update tx to
     exercise validator-set changes mid-chain (provide the matching signing
-    keys via `extra_keys`)."""
-    gen, keys = make_genesis(n_validators, chain_id, seed)
+    keys via `extra_keys`). key_type="bls12_381" signs with BLS keys
+    (genesis PoPs included); aggregate=True additionally folds each
+    commit into the AggregatedCommit seal (uniform timestamps, so the
+    whole commit is one pairing group)."""
+    gen, keys = make_genesis(n_validators, chain_id, seed,
+                             key_type=key_type)
     for k in extra_keys or []:
         keys[k.pub_key().address()] = k
     state = State.from_genesis(gen)
@@ -103,7 +123,11 @@ def generate_chain(n_blocks: int, n_validators: int = 4,
             h, txs, last_commit, proposer.address,
             timestamp=Timestamp(1_700_000_000 + h, 0))
         block_id = BlockID(block.hash(), block.make_part_set().header)
-        commit = sign_commit(chain_id, h, 0, block_id, state.validators, keys)
+        commit = sign_commit(chain_id, h, 0, block_id, state.validators,
+                             keys, uniform_ts=aggregate)
+        if aggregate:
+            from ..types.agg_commit import maybe_aggregate
+            commit = maybe_aggregate(commit, state.validators)
         valsets.append(state.validators.copy())
         state, _ = executor.apply_block(state, block_id, block)
         blocks.append(block)
@@ -189,6 +213,15 @@ def _corrupt_block(block: Block, mode: str) -> Block:
     import dataclasses
     if mode == "sig":
         lc = block.last_commit
+        from ..types.agg_commit import AggregatedCommit
+        if isinstance(lc, AggregatedCommit):
+            # the aggregated seal's analog of a flipped lane signature
+            # is a flipped aggregate byte (covered lanes carry none)
+            return Block(header=block.header, data=block.data,
+                         last_commit=dataclasses.replace(
+                             lc, agg_sig=lc.agg_sig[:1]
+                             + bytes([lc.agg_sig[1] ^ 1])
+                             + lc.agg_sig[2:]))
         sigs = list(lc.signatures)
         s = sigs[0]
         sigs[0] = CommitSig(s.block_id_flag, s.validator_address,

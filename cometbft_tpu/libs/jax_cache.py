@@ -1,15 +1,33 @@
-"""Shared jax persistent compile-cache setup.
+"""Shared jax persistent compile-cache setup + the compile ledger.
 
 jax is pre-imported by the ambient environment (sitecustomize), so env
 vars like JAX_COMPILATION_CACHE_DIR are latched before any entry point
 runs — configuration MUST go through jax.config. Every entry point
 (tests, bench, graft entry, tools) calls this one helper so the cache
 location and threshold stay consistent.
+
+The CompileLedger (ROADMAP item-5 residual) persists which
+(kernel, shape-bucket) pairs have compiled on which platform/jax
+version, how long each compile took, and which pairs CRASHED the
+compiler — so bench and device-server runs can (a) attribute
+hit/miss/cold-compile in their JSON instead of silently eating a
+multi-minute XLA compile, and (b) skip shape buckets known to kill
+XLA:CPU outright (docs/PERF.md "known compile hazard") instead of
+rediscovering the SIGSEGV every round. On device platforms the jax
+persistent cache holds the actual executables; the ledger is the
+keying + attribution layer over it (XLA:CPU executables are never
+persisted — machine-feature reloads risk SIGILL — so on cpu a "seen"
+entry predicts a warm in-process recompile cost, not an artifact
+reload).
 """
 
 from __future__ import annotations
 
+import contextlib
+import json
 import os
+import threading
+import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -88,6 +106,137 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         "jax_compilation_cache_dir",
         cache_dir or os.path.join(_REPO_ROOT, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+class CompileLedger:
+    """On-disk record of (kernel, shape-bucket) compiles.
+
+    Entries are keyed "kernel|bucket|platform|jax-version" so a ledger
+    written against one backend or jax build never mispredicts
+    another. All methods are best-effort on I/O errors: the ledger
+    must never be able to fail a measurement run."""
+
+    # guarded-by: _lock: _entries, hits, misses
+    def __init__(self, path: str | None = None):
+        self.path = path or os.path.join(_REPO_ROOT, ".jax_cache",
+                                         "ledger.json")
+        self._lock = threading.Lock()
+        self.hits = 0       # compile_guard entries already in the ledger
+        self.misses = 0     # cold entries recorded this process
+        try:
+            with open(self.path) as f:
+                self._entries: dict = json.load(f)
+        except (OSError, ValueError):
+            self._entries = {}
+
+    def _save(self, entries: dict) -> None:
+        """Persist a snapshot (passed in so every self._entries access
+        stays lexically under the lock), MERGED over the on-disk state:
+        concurrent writers (bench parent + --measure subprocess, or a
+        device server alongside a bench) each contribute their keys
+        instead of the last writer erasing the others'. Our entries win
+        only on key conflict."""
+        try:
+            try:
+                with open(self.path) as f:
+                    merged = json.load(f)
+            except (OSError, ValueError):
+                merged = {}
+            merged.update(entries)
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(merged, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+    @staticmethod
+    def _env() -> str:
+        try:
+            import jax
+            ver = jax.__version__
+        except Exception:  # noqa: BLE001 — ledger must never fail callers
+            ver = "?"
+        return f"{first_configured_platform() or 'cpu'}|{ver}"
+
+    def key(self, kernel: str, bucket: int) -> str:
+        return f"{kernel}|{bucket}|{self._env()}"
+
+    def seen(self, kernel: str, bucket: int) -> bool:
+        with self._lock:
+            e = self._entries.get(self.key(kernel, bucket))
+        return bool(e) and not e.get("crashed")
+
+    def known_crash(self, kernel: str, bucket: int) -> bool:
+        with self._lock:
+            e = self._entries.get(self.key(kernel, bucket))
+        return bool(e) and bool(e.get("crashed"))
+
+    def record(self, kernel: str, bucket: int, compile_s: float) -> None:
+        with self._lock:
+            self._entries[self.key(kernel, bucket)] = {
+                "kernel": kernel, "bucket": bucket,
+                "compile_s": round(float(compile_s), 3),
+                "recorded_unix": int(time.time()),  # staticcheck: allow(wallclock)
+            }
+            self._save(dict(self._entries))
+
+    def record_crash(self, kernel: str, bucket: int,
+                     detail: str = "") -> None:
+        with self._lock:
+            self._entries[self.key(kernel, bucket)] = {
+                "kernel": kernel, "bucket": bucket, "crashed": True,
+                "detail": detail[:200],
+                "recorded_unix": int(time.time()),  # staticcheck: allow(wallclock)
+            }
+            self._save(dict(self._entries))
+
+    @contextlib.contextmanager
+    def compile_guard(self, kernel: str, bucket: int):
+        """Wrap a possibly-compiling call: attributes a ledger hit or
+        miss, times the first-touch cost, and records it on SUCCESS.
+        A raising guard records nothing — a transient runtime failure
+        (transport error mid-warm) must not brand a bucket
+        compiler-fatal; only explicit record_crash calls (e.g. bench's
+        subprocess-killed-by-signal detection) do that, and a later
+        successful record() clears the verdict."""
+        warm = self.seen(kernel, bucket)
+        t0 = time.monotonic()  # staticcheck: allow(wallclock)
+        yield
+        dt = time.monotonic() - t0  # staticcheck: allow(wallclock)
+        with self._lock:
+            if warm:
+                self.hits += 1
+            else:
+                self.misses += 1
+        if not warm:
+            self.record(kernel, bucket, dt)
+
+    def attribution(self) -> dict:
+        """Process-level summary for bench JSON."""
+        with self._lock:
+            return {"ledger": self.path, "hits": self.hits,
+                    "misses": self.misses}
+
+
+_ledger: CompileLedger | None = None
+_ledger_lock = threading.Lock()
+
+
+def ledger() -> CompileLedger:
+    global _ledger
+    with _ledger_lock:
+        if _ledger is None:
+            _ledger = CompileLedger()
+        return _ledger
+
+
+def reset_ledger(path: str | None = None) -> None:
+    """Point the process at a fresh ledger (tests)."""
+    global _ledger
+    with _ledger_lock:
+        _ledger = CompileLedger(path) if path else None
 
 
 def disable_persistent_cache() -> None:

@@ -123,6 +123,24 @@ METRICS_SPEC = {
          "ingest_admission_latency_seconds",
          "Submit-to-verdict admission latency, seconds", ()),
     ],
+    # aggsig/ — the BLS aggregate-commit fast path (aggsig/verify.py):
+    # one multi-pairing check per commit instead of n signature
+    # verifies, kernel-batched final exponentiations during blocksync
+    "AggsigMetrics": [
+        ("counter", "pairings_total", "aggsig_pairings_total",
+         "Miller-loop evaluations spent verifying aggregated commits "
+         "(the O(1)-per-commit evidence vs 2n per-signature)", ()),
+        ("counter", "aggregates_verified", "aggsig_aggregates_verified",
+         "Aggregated-commit final-exponentiation verdicts, by backend "
+         "(kernel vs cpu)", ("backend",)),
+        ("counter", "pop_rejections", "aggsig_pop_rejections",
+         "Proof-of-possession failures (bad PoP at admission, or an "
+         "aggregate signer without a registered PoP)", ()),
+        ("counter", "canary_failures", "aggsig_canary_failures",
+         "Kernel batches whose known-answer final-exp canaries "
+         "answered wrong (kernel quarantined, batch re-run on CPU)",
+         ()),
+    ],
     # reference mempool/metrics.go
     "MempoolMetrics": [
         ("gauge", "size", "mempool_size",

@@ -18,7 +18,8 @@ from ..config import Config
 from ..consensus.reactor import ConsensusReactor
 from ..consensus.state import ConsensusConfig, ConsensusState
 from ..consensus.wal import WAL
-from ..crypto.keys import Ed25519PrivKey, Ed25519PubKey
+from ..crypto.keys import (Ed25519PrivKey, Ed25519PubKey,
+                           pubkey_from_type_bytes)
 from ..db.kv import open_db
 from ..engine.reactor import BlocksyncNetReactor, NetSource
 from ..evidence.pool import EvidencePool
@@ -61,10 +62,13 @@ def save_genesis(gen: GenesisDoc, path: str) -> None:
             "genesis_time": [gen.genesis_time.seconds,
                              gen.genesis_time.nanos],
             "validators": [{"pub_key": v.pub_key.bytes_().hex(),
+                            "type": v.pub_key.type_(),
                             "power": v.voting_power}
                            for v in gen.validators],
             "app_state": gen.app_state.hex(),
             "app_hash": gen.app_hash.hex(),
+            "bls_pops": {pub.hex(): pop.hex()
+                         for pub, pop in gen.bls_pops.items()},
         }, f, indent=1)
 
 
@@ -75,10 +79,14 @@ def load_genesis(path: str) -> GenesisDoc:
         chain_id=d["chain_id"],
         initial_height=d.get("initial_height", 1),
         genesis_time=Timestamp(*d.get("genesis_time", [0, 0])),
-        validators=[Validator(Ed25519PubKey(bytes.fromhex(v["pub_key"])),
-                              v["power"]) for v in d["validators"]],
+        validators=[Validator(
+            pubkey_from_type_bytes(v.get("type", "ed25519"),
+                                   bytes.fromhex(v["pub_key"])),
+            v["power"]) for v in d["validators"]],
         app_state=bytes.fromhex(d.get("app_state", "")),
-        app_hash=bytes.fromhex(d.get("app_hash", "")))
+        app_hash=bytes.fromhex(d.get("app_hash", "")),
+        bls_pops={bytes.fromhex(pub): bytes.fromhex(pop)
+                  for pub, pop in d.get("bls_pops", {}).items()})
 
 
 class Node:
@@ -109,6 +117,14 @@ class Node:
             # bootstrap-save so the genesis validator set is indexed at
             # the initial height (reference state/store.go Bootstrap)
             self.state_store.save(state)
+        elif self.genesis.bls_pops:
+            # the PoP registry is process-local: a RESTARTED node loads
+            # state from the store and skips from_genesis, so the
+            # genesis proofs of possession must be re-admitted here or
+            # every valid aggregated commit would be rejected for
+            # missing PoPs (docs/AGGSIG.md "PoP policy")
+            from ..aggsig.aggregate import register_pops_batch
+            register_pops_batch(self.genesis.bls_pops)
 
         # --- proxy app (node.go:319): in-process app, explicit client
         # creator, or [base] proxy_app = tcp://host:port (the socket
@@ -197,12 +213,20 @@ class Node:
         # libs/metrics_defs.py — the reference's scripts/metricsgen
         # role): mempool occupancy now, p2p wiring after the switch
         # exists below
-        from ..libs.metrics_gen import (DeviceMetrics, MempoolMetrics,
-                                        P2PMetrics, PipelineMetrics)
+        from ..libs.metrics_gen import (AggsigMetrics, DeviceMetrics,
+                                        MempoolMetrics, P2PMetrics,
+                                        PipelineMetrics)
         self._p2p_metrics_cls = P2PMetrics
         self.mempool.metrics = MempoolMetrics(self.metrics_registry)
         self.pipeline_metrics = PipelineMetrics(self.metrics_registry)
         self.device_metrics = DeviceMetrics(self.metrics_registry)
+        # aggregate-commit verification counters (aggsig/verify.py) —
+        # module-shared like the SigCache: several in-process nodes
+        # verify through one aggsig path, first node wins
+        from ..aggsig import verify as _aggsig_verify
+        self.aggsig_metrics = AggsigMetrics(self.metrics_registry)
+        if _aggsig_verify._metrics is None:
+            _aggsig_verify.set_metrics(self.aggsig_metrics)
         # the per-process device health supervisor (device/health.py):
         # wedge recovery probing, canary-verified batches, reconnect
         # backoff. Knobs from [device]; first node wins for metrics and

@@ -1,0 +1,280 @@
+"""BLS12-381 Fq/Fq2/Fq12 arithmetic as batched JAX ops — the
+aggregate-commit final-exponentiation kernel.
+
+What runs on device and why: aggregate-commit verification
+(aggsig/verify.py) is (k+1) Miller loops plus ONE final exponentiation
+per commit. The Miller loop is control-flow-irregular host work, but
+the final exponentiation's hard part is a FIXED ~1270-bit
+square-and-multiply chain of pure Fq12 mul/square — identical
+instruction stream for every commit, i.e. exactly the lane-parallel
+shape the chip wants. During blocksync the host marshals many commits'
+Miller products and this kernel settles all their
+`final_exp(m) == 1` verdicts in one batch.
+
+Field representation follows ops/field.py's TPU discipline: little-
+endian 16-bit limbs in int32 (24 limbs for the 381-bit modulus), limb
+axis LEADING and batch trailing, all products computed exactly in
+uint32 and split into lo/hi halves immediately — no int64 anywhere
+(TPU emulates s64; jax default is 32-bit). The modulus has no
+pseudo-Mersenne fold, so multiplication is word-by-word Montgomery
+(CIOS): per step the column magnitudes stay < ~2^23, int32-safe.
+
+Tower shapes mirror crypto/bls12381.py: Fq2 is a python pair of Fq
+arrays, Fq12 a 6-tuple of Fq2 over the flat w-basis (w^6 = ξ = 1+u);
+the 36 Fq2 products of an Fq12 multiply are stacked on a trailing axis
+so each Karatsuba leg is ONE batched Montgomery multiply.
+
+Correctness is oracle-pinned (tests/test_aggsig.py): mont_mul against
+python ints, the pow chain against f12_pow on small exponents, and the
+full hard-part verdicts against crypto/bls12381.final_exponentiation
+(slow marker — the scan compile is the multi-minute XLA:CPU hazard the
+compile-cache ledger in libs/jax_cache attributes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls12381 import P as P_INT, _HARD_EXP
+
+NLIMBS = 24
+LIMB_BITS = 16
+MASK = (1 << LIMB_BITS) - 1
+R_INT = 1 << (NLIMBS * LIMB_BITS)            # Montgomery radix 2^384
+NINV_INT = (-pow(P_INT, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+ONE_MONT_INT = R_INT % P_INT
+
+# final-exp hard-part bits, MSB-first (the leading 1 seeds the chain)
+HARD_BITS = tuple(int(b) for b in bin(_HARD_EXP)[2:])
+
+BUCKETS = (4, 16, 64)  # compiled batch shapes (aggsig tile widths)
+
+
+def limbs_from_int(x: int) -> np.ndarray:
+    x %= 1 << (NLIMBS * LIMB_BITS)
+    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)],
+                    dtype=np.int32)
+
+
+def int_from_limbs(limbs) -> int:
+    arr = np.asarray(limbs)
+    return sum(int(arr[i]) << (LIMB_BITS * i) for i in range(NLIMBS))
+
+
+P_LIMBS = limbs_from_int(P_INT)
+P_U32 = P_LIMBS.astype(np.uint32)
+
+
+def _bc(const: np.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    c = jnp.asarray(const)
+    return c.reshape(c.shape + (1,) * (like.ndim - 1))
+
+
+def _csub_p(r: jnp.ndarray) -> jnp.ndarray:
+    """r in [0, 2P) limb-canonical -> r mod P. Borrow chain with
+    arithmetic shifts (int32 two's complement makes `& MASK` exact
+    mod-2^16 for the small negatives that appear)."""
+    d = r - _bc(P_LIMBS, r)
+    outs = []
+    carry = jnp.zeros_like(r[0])
+    for j in range(NLIMBS):
+        v = d[j] + carry
+        outs.append(v & MASK)
+        carry = v >> LIMB_BITS
+    dn = jnp.stack(outs, axis=0)
+    return jnp.where((carry < 0)[None], r, dn)
+
+
+def _carry_chain(t: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Propagate carries of a column vector (any per-column magnitude
+    within int32) into canonical 16-bit limbs; the represented value
+    must fit out_limbs limbs."""
+    outs = []
+    carry = jnp.zeros_like(t[0])
+    for j in range(t.shape[0]):
+        v = t[j] + carry
+        outs.append(v & MASK)
+        carry = v >> LIMB_BITS
+    return jnp.stack(outs[:out_limbs], axis=0)
+
+
+def add_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _csub_p(_carry_chain(a + b, NLIMBS))
+
+
+def sub_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _csub_p(_carry_chain(a - b + _bc(P_LIMBS, a), NLIMBS))
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a·b·R^-1 mod P (CIOS). Inputs canonical
+    (limbs < 2^16, value < P); per-step column bound ~24·4·2^16 < 2^23,
+    int32-exact; 16x16-bit products are computed in uint32 and split
+    into lo/hi halves immediately (ops/field.py discipline)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    bu = b.astype(jnp.uint32)
+    t0 = jnp.zeros((NLIMBS + 2,) + a.shape[1:], jnp.int32)
+
+    def step(i, t):
+        ai = lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False)
+        prod = ai.astype(jnp.uint32)[None] * bu
+        t = t.at[0:NLIMBS].add((prod & MASK).astype(jnp.int32))
+        t = t.at[1:NLIMBS + 1].add((prod >> LIMB_BITS).astype(jnp.int32))
+        m = ((t[0] & MASK).astype(jnp.uint32) * NINV_INT) & MASK
+        pm = m[None] * jnp.asarray(P_U32).reshape(
+            (NLIMBS,) + (1,) * (t.ndim - 1))
+        t = t.at[0:NLIMBS].add((pm & MASK).astype(jnp.int32))
+        t = t.at[1:NLIMBS + 1].add((pm >> LIMB_BITS).astype(jnp.int32))
+        carry = t[0] >> LIMB_BITS   # t[0] ≡ 0 mod 2^16 by choice of m
+        t = jnp.concatenate([t[1:], jnp.zeros_like(t[:1])], axis=0)
+        t = t.at[0].add(carry)
+        return t
+
+    t = lax.fori_loop(0, NLIMBS, step, t0)
+    # t < 2P (CIOS bound), which fits 24 limbs after carrying
+    return _csub_p(_carry_chain(t, NLIMBS))
+
+
+# --- Fq2 / Fq12 towers (python tuples of limb arrays) -------------------------
+
+F2J = Tuple[jnp.ndarray, jnp.ndarray]
+
+
+def f2_add(a: F2J, b: F2J) -> F2J:
+    return (add_mod(a[0], b[0]), add_mod(a[1], b[1]))
+
+
+def f2_mul_xi(a: F2J) -> F2J:
+    """Multiply by ξ = 1 + u: (a0 - a1, a0 + a1)."""
+    return (sub_mod(a[0], a[1]), add_mod(a[0], a[1]))
+
+
+_PAIRS = [(i, j) for i in range(6) for j in range(6)]
+
+
+def f12_mul(x, y):
+    """Flat w-basis product, mirroring crypto/bls12381.f12_mul. The 36
+    Fq2 coefficient products ride ONE batched Montgomery multiply per
+    Karatsuba leg (pairs stacked on a trailing axis)."""
+    a0 = jnp.stack([x[i][0] for i, _ in _PAIRS], axis=-1)
+    a1 = jnp.stack([x[i][1] for i, _ in _PAIRS], axis=-1)
+    b0 = jnp.stack([y[j][0] for _, j in _PAIRS], axis=-1)
+    b1 = jnp.stack([y[j][1] for _, j in _PAIRS], axis=-1)
+    v0 = mont_mul(a0, b0)
+    v1 = mont_mul(a1, b1)
+    s = mont_mul(add_mod(a0, a1), add_mod(b0, b1))
+    re = sub_mod(v0, v1)
+    im = sub_mod(sub_mod(s, v0), v1)
+    acc = {}
+    for n, (i, j) in enumerate(_PAIRS):
+        k = i + j
+        c = (re[..., n], im[..., n])
+        acc[k] = c if k not in acc else f2_add(acc[k], c)
+    for k in range(10, 5, -1):
+        acc[k - 6] = f2_add(acc[k - 6], f2_mul_xi(acc[k]))
+    return tuple(acc[k] for k in range(6))
+
+
+def pow_bits(m, bits: Sequence[int]):
+    """m^e for e's MSB-first bit string (bits[0] must be 1), via
+    lax.scan square-and-multiply — the fixed-exponent chain."""
+    assert bits[0] == 1
+
+    def body(acc, bit):
+        sq = f12_mul(acc, acc)
+        wm = f12_mul(sq, m)
+        out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(bit, b, a), sq, wm)
+        return out, None
+
+    acc, _ = lax.scan(body, m, jnp.asarray(list(bits[1:]), jnp.int32))
+    return acc
+
+
+def _is_one_mont(x) -> jnp.ndarray:
+    """Per-lane equality with the Montgomery ONE."""
+    one = jnp.asarray(limbs_from_int(ONE_MONT_INT))
+    ok = jnp.ones(x[0][0].shape[1:], bool)
+    for i in range(6):
+        for c in range(2):
+            want = (one.reshape((NLIMBS,) + (1,) * (x[i][c].ndim - 1))
+                    if (i, c) == (0, 0) else jnp.zeros((1,), jnp.int32))
+            ok = ok & jnp.all(x[i][c] == want, axis=0)
+    return ok
+
+
+# --- host packing / entry points ----------------------------------------------
+
+def _pack(elems) -> np.ndarray:
+    """python F12 tuples -> (6, 2, NLIMBS, B) int32 Montgomery limbs."""
+    out = np.zeros((6, 2, NLIMBS, len(elems)), np.int32)
+    for b, f in enumerate(elems):
+        for i in range(6):
+            for c in range(2):
+                out[i, c, :, b] = limbs_from_int(f[i][c] * R_INT % P_INT)
+    return out
+
+
+def _unpack_tree(arr: jnp.ndarray):
+    return tuple((arr[i, 0], arr[i, 1]) for i in range(6))
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(bucket: int, bits: Tuple[int, ...]):
+    def run(arr):
+        return _is_one_mont(pow_bits(_unpack_tree(arr), bits))
+    return jax.jit(run)
+
+
+def pow_is_one_batch(elems, bits: Tuple[int, ...],
+                     bucket: int) -> List[bool]:
+    """`m^e == 1` per lane for python-int F12 elements; pads the batch
+    to the compiled bucket with Montgomery ONE (1^e == 1, sliced off).
+    Exponent bits are static — one compile per (bucket, exponent)."""
+    if len(elems) > bucket:
+        raise ValueError(f"batch {len(elems)} exceeds bucket {bucket}")
+    pad = bucket - len(elems)
+    # padding element: the multiplicative identity (1^e == 1)
+    identity = tuple(((1, 0) if i == 0 else (0, 0)) for i in range(6))
+    batch = list(elems) + [identity] * pad
+    arr = _pack(batch)
+    fn = _compiled(bucket, bits)
+    out = np.asarray(fn(jnp.asarray(arr)))
+    return [bool(v) for v in out[:len(elems)]]
+
+
+def bucket_for(n: int) -> int:
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return BUCKETS[-1]
+
+
+def final_exp_is_one_batch(products) -> List[bool]:
+    """Batched `final_exponentiation(m) == 1` verdicts for Miller
+    products: the easy part (inversion + Frobenius) runs host-side per
+    element, the fixed hard-part pow chain runs lane-parallel on the
+    default jax backend. Batches wider than the largest bucket are
+    chunked. First use of a bucket pays (or reloads, on device
+    platforms with the persistent cache) the scan compile — recorded
+    in the libs/jax_cache compile ledger keyed
+    ("bls12-finalexp", bucket)."""
+    from ..crypto.bls12381 import final_exp_easy
+    from ..libs.jax_cache import ledger
+    verdicts: List[bool] = []
+    i = 0
+    products = list(products)
+    while i < len(products):
+        chunk = products[i:i + BUCKETS[-1]]
+        easied = [final_exp_easy(f) for f in chunk]
+        bucket = bucket_for(len(easied))
+        with ledger().compile_guard("bls12-finalexp", bucket):
+            verdicts.extend(pow_is_one_batch(easied, HARD_BITS, bucket))
+        i += len(chunk)
+    return verdicts

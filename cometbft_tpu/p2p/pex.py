@@ -120,7 +120,7 @@ class PexReactor:
         # node uses an in-memory book with path=None)
         if not self.book._rng_injected:
             self.book._rng = random.Random(
-                b"pex-book:" + switch.priv_key.seed)
+                b"pex-book:" + switch.priv_key.bytes_())
 
     def get_channels(self) -> List[ChannelDescriptor]:
         return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,

@@ -166,8 +166,10 @@ class FilePV:
     def load(cls, state_path: str) -> "FilePV":
         with open(state_path, "rb") as f:
             d = json.load(f)
+        from ..crypto.keys import privkey_from_type_bytes
         return cls(
-            Ed25519PrivKey(bytes.fromhex(d["priv_key"])),
+            privkey_from_type_bytes(d.get("key_type", "ed25519"),
+                                    bytes.fromhex(d["priv_key"])),
             state_path,
             _LastSignState(
                 height=d["height"], round=d["round"], step=d["step"],
@@ -189,7 +191,8 @@ class FilePV:
         if self.state_path is None:
             return
         data = json.dumps({
-            "priv_key": self.priv_key.seed.hex(),
+            "priv_key": self.priv_key.bytes_().hex(),
+            "key_type": self.priv_key.type_(),
             "address": self.priv_key.pub_key().address().hex(),
             "height": self.last.height,
             "round": self.last.round,

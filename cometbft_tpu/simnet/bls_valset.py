@@ -1,0 +1,185 @@
+"""bls-valset scenario: the REAL consensus engine on a uniformly-BLS
+validator set, plus sync-vs-aggregate verdict equivalence.
+
+Phase 1 — engine: four validators with bls12_381 keys (genesis proofs
+of possession) run the real consensus state machine on the virtual
+clock; node 0 is deferred and catches up through the real blocksync
+engine, so aggregated seals flow through BOTH verification routes —
+proposal validation (types/validation -> aggsig) and the blocksync
+marshal/settle path (engine/blocksync AggSeal batching). After the
+run, every stored block past the first must carry an AggregatedCommit
+seal (logged per height with its signer count); a plain commit on a
+BLS valset here would mean the assembly gate silently failed open.
+
+Phase 2 — equivalence: a seeded chain_gen BLS chain yields a plain
+per-lane commit and its aggregated twin built FROM THE SAME votes;
+both are verified through the public verify_commit form and the
+verdicts must agree on every tamper class:
+
+  clean             both accept
+  tampered-sig      one signer's signature replaced by a valid G2
+                    point over the wrong message -> both reject
+  signers-3         one honest absence, bitmap undercounts but power
+                    still > 2/3 -> both accept
+  forged-bitmap     a bitmap bit set for a validator whose signature
+                    is NOT in the aggregate -> both reject
+  undercount        two absences, power <= 2/3 -> both reject
+
+Everything is a pure function of (scenario, seed): keys, timestamps,
+and fault draws come from the scenario PRNG / virtual clock, and the
+combined event log is byte-identical per seed (pinned by
+tests/test_simnet.py like every other scenario).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import replace as dc_replace
+from typing import List
+
+from ..engine.chain_gen import generate_chain
+from ..types import validation
+from ..types.agg_commit import AggregatedCommit, from_commit
+from ..types.block import CommitSig
+from .harness import Scenario, SimResult, Simulation
+
+
+def _setup_bls(sim: Simulation) -> None:
+    # node 0 joins late through the real blocksync engine: aggregated
+    # seals must verify through the marshal/settle route, not only the
+    # consensus proposal path
+    sim.defer(0)
+    sim.at(1400, lambda: sim.blocksync_join(0))
+
+
+def _engine_phase(scenario: Scenario, seed: int, quick: bool, workdir,
+                  log_lines: List[str], violations: List[str]):
+    eng = dc_replace(scenario, runner=None, setup=_setup_bls,
+                     key_type="bls12_381")
+    sim = Simulation(eng, seed, workdir=workdir, quick=quick)
+    res = sim.run()
+    log_lines.extend(res.log_lines)
+    violations.extend(res.violations)
+    # every committed block past height 1 must seal with the aggregate
+    # form — inspect a node that ran consensus from the start
+    store = sim.nodes[1].block_store
+    h = 2
+    sealed = 0
+    while True:
+        blk = store.load_block(h)
+        if blk is None:
+            break
+        lc = blk.last_commit
+        if isinstance(lc, AggregatedCommit):
+            sealed += 1
+            log_lines.append(
+                f"agg_seal h={h - 1} signers={len(lc.covered_indices())} "
+                f"bitmap={lc.bitmap.hex()}")
+        else:
+            violations.append(
+                f"plain commit sealing height {h - 1} on a BLS valset")
+            log_lines.append(f"violation msg=plain_commit_at_{h - 1}")
+        h += 1
+    if sealed == 0:
+        violations.append("no aggregated seals committed")
+        log_lines.append("violation msg=no_aggregated_seals")
+    return res
+
+
+def _equivalence_phase(seed: int, log_lines: List[str],
+                       violations: List[str]) -> None:
+    chain = generate_chain(n_blocks=1, n_validators=4,
+                           chain_id="bls-equiv", seed=1000 + seed,
+                           key_type="bls12_381", txs_per_block=1)
+    plain = chain.seen_commits[0]
+    vals = chain.valsets[0]
+    bid = chain.block_ids[0]
+    cid = chain.chain_id
+
+    def verdict(commit) -> bool:
+        try:
+            validation.verify_commit(cid, vals, bid, 1, commit)
+            return True
+        except validation.CommitVerificationError:
+            return False
+
+    def absent_lanes(commit, lanes):
+        sigs = [CommitSig.absent() if i in lanes else cs
+                for i, cs in enumerate(commit.signatures)]
+        return dc_replace(commit, signatures=sigs)
+
+    # tampered lane: a VALID G2 point that is the signature of the
+    # wrong message — the pairing check, not decompression, must fail
+    val0 = vals.validators[0]
+    wrong_sig = chain.keys[val0.address].sign(
+        b"equivocation bait: not the canonical precommit bytes")
+    tampered = dc_replace(plain, signatures=[
+        dc_replace(cs, signature=wrong_sig) if i == 0 else cs
+        for i, cs in enumerate(plain.signatures)])
+
+    three = absent_lanes(plain, {3})
+    two = absent_lanes(plain, {2, 3})
+
+    agg_three = from_commit(three)
+    # forged bitmap: claim validator 3 signed (flag + bit set) while
+    # the aggregate only holds the other three signatures
+    cs3 = plain.signatures[3]
+    forged_sigs = list(agg_three.signatures)
+    forged_sigs[3] = CommitSig(cs3.block_id_flag, cs3.validator_address,
+                               cs3.timestamp, b"")
+    from ..aggsig.aggregate import bitmap_encode
+    forged = AggregatedCommit(
+        height=agg_three.height, round=agg_three.round,
+        block_id=agg_three.block_id, signatures=forged_sigs,
+        bitmap=bitmap_encode([True] * 4), agg_sig=agg_three.agg_sig)
+    # the plain analog of the forgery: validator 3 "signs" with a
+    # signature that cannot be its own (lane 0's bytes)
+    forged_plain = dc_replace(plain, signatures=[
+        dc_replace(cs, signature=plain.signatures[0].signature)
+        if i == 3 else cs
+        for i, cs in enumerate(plain.signatures)])
+
+    cases = [
+        ("clean", plain, from_commit(plain)),
+        ("tampered-sig", tampered, from_commit(tampered)),
+        ("signers-3", three, agg_three),
+        ("forged-bitmap", forged_plain, forged),
+        ("undercount", two, from_commit(two)),
+    ]
+    want = {"clean": True, "tampered-sig": False, "signers-3": True,
+            "forged-bitmap": False, "undercount": False}
+    for name, ref_c, agg_c in cases:
+        r = verdict(ref_c)
+        a = verdict(agg_c)
+        log_lines.append(f"equiv case={name} ref={int(r)} agg={int(a)}")
+        if r != a:
+            violations.append(
+                f"sync-vs-aggregate verdict divergence: {name} "
+                f"(ref={r}, agg={a})")
+            log_lines.append(f"violation msg=equiv_divergence_{name}")
+        if r != want[name]:
+            violations.append(f"reference verdict wrong for {name}")
+            log_lines.append(f"violation msg=ref_verdict_{name}")
+
+
+def run_bls_valset(scenario: Scenario, seed: int, quick: bool = False,
+                   workdir=None) -> SimResult:
+    log_lines: List[str] = []
+    violations: List[str] = []
+    res = _engine_phase(scenario, seed, quick, workdir,
+                        log_lines, violations)
+    _equivalence_phase(seed, log_lines, violations)
+    log_lines.append(f"bls_end violations={len(violations)}")
+    digest = hashlib.sha256()
+    for line in log_lines:
+        digest.update(line.encode())
+        digest.update(b"\n")
+    return SimResult(
+        scenario=scenario.name, seed=seed, violations=violations,
+        max_height=res.max_height, heights=res.heights,
+        app_hashes=res.app_hashes, log_lines=log_lines,
+        digest=digest.hexdigest(), wall_s=res.wall_s,
+        virtual_s=res.virtual_s,
+        commits_per_sim_s=res.commits_per_sim_s, crashes=res.crashes,
+        restarts=res.restarts, evidence_seen=res.evidence_seen,
+        errors=res.errors, stats=res.stats)

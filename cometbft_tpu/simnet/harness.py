@@ -104,6 +104,7 @@ class Scenario:
     n_vals: int = 4
     quick_target: int = 3
     runner: Optional[Callable[..., "SimResult"]] = None
+    key_type: str = "ed25519"  # validator key type (bls12_381 = aggsig)
 
 
 @dataclass
@@ -138,16 +139,25 @@ class SimResult:
                 f"--scenario {self.scenario} --seed {self.seed}")
 
 
-def make_genesis(n_vals: int, rng: random.Random, chain_id: str):
+def make_genesis(n_vals: int, rng: random.Random, chain_id: str,
+                 key_type: str = "ed25519"):
     """Deterministic keys + genesis (the tests/cluster.py recipe with a
-    pinned genesis time so nothing depends on the host clock)."""
-    keys = [Ed25519PrivKey.generate(rng) for _ in range(n_vals)]
+    pinned genesis time so nothing depends on the host clock).
+    key_type="bls12_381" builds a uniformly-BLS valset with genesis
+    proofs of possession — the aggregate-commit configuration."""
+    if key_type == "bls12_381":
+        from ..aggsig.aggregate import deterministic_keys_with_pops
+        keys, pops = deterministic_keys_with_pops(n_vals, rng)
+    else:
+        keys = [Ed25519PrivKey.generate(rng) for _ in range(n_vals)]
+        pops = {}
     vals = [Validator(k.pub_key(), 10) for k in keys]
     order = sorted(range(n_vals), key=lambda i: vals[i].address)
     gen = GenesisDoc(
         chain_id=chain_id,
         genesis_time=Timestamp(GENESIS_EPOCH_NS // 1_000_000_000, 0),
-        validators=[vals[i] for i in order])
+        validators=[vals[i] for i in order],
+        bls_pops=pops)
     return [keys[i] for i in order], gen
 
 
@@ -184,6 +194,13 @@ class SimNode:
         if state is None:
             state = State.from_genesis(self.gen)
             self.state_store.save(state)
+        elif self.gen.bls_pops:
+            # crash-restart path: the stored state skips from_genesis,
+            # so re-admit the genesis PoPs (idempotent; free within a
+            # process, and what a real restarted process must do —
+            # node/node.py does the same)
+            from ..aggsig.aggregate import register_pops_batch
+            register_pops_batch(self.gen.bls_pops)
         # ABCI handshake: replay stored blocks the (fresh, in-memory)
         # app has not seen (node.py _handshake)
         info = self.app.info()
@@ -262,7 +279,8 @@ class Simulation:
         self.net = SimNetwork(self.clock, self.rng, self.log)
         self.net.guard = self.guarded
         keys, self.gen = make_genesis(
-            scenario.n_vals, self.rng, f"simnet-{scenario.name}")
+            scenario.n_vals, self.rng, f"simnet-{scenario.name}",
+            key_type=scenario.key_type)
         self.nodes = [SimNode(i, k, self.gen, SIM_CONFIG, self.workdir)
                       for i, k in enumerate(keys)]
         self.deferred: set = set()

@@ -19,6 +19,7 @@ from ..consensus.reactor import (DATA_CHANNEL, VOTE_CHANNEL, _BLOCK_PART,
                                  _PROPOSAL, _VOTE)
 from ..types.block import BlockID
 from ..types.vote import Vote
+from .bls_valset import run_bls_valset as _run_bls_valset
 from .clock import MS
 from .flash_crowd import run_flash_crowd as _run_flash_crowd
 from .harness import Scenario, Simulation
@@ -234,6 +235,14 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
              "LightClient.tla acceptance rules",
              target_height=20, deadline_ms=0,
              runner=_run_light_farm),
+    Scenario("bls-valset", "the real engine on a uniformly-BLS "
+             "validator set: commits seal as BLS aggregates (one "
+             "pairing equation each), a late joiner blocksyncs "
+             "through the AggSeal marshal route, and sync-vs-"
+             "aggregate verdicts must agree on clean / tampered-sig / "
+             "forged-bitmap / undercount chains",
+             target_height=3, deadline_ms=120_000, quick_target=2,
+             runner=_run_bls_valset),
     Scenario("flash-crowd", "thousands of seeded virtual clients burst "
              "signed txs at the batched admission pipeline; the bounded "
              "queue sheds, the duplicate filter hits, tampered "

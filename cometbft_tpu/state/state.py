@@ -10,7 +10,7 @@ from dataclasses import dataclass, field as dc_field, replace
 from typing import List, Optional
 
 from ..crypto import merkle
-from ..crypto.keys import Ed25519PubKey
+from ..crypto.keys import Ed25519PubKey, pubkey_from_type_bytes
 from ..types import proto
 from ..types.block import Block, BlockID, Commit, Data, Header
 from ..types.proto import Timestamp
@@ -76,6 +76,12 @@ class GenesisDoc:
         default_factory=ConsensusParams)
     app_state: bytes = b""
     app_hash: bytes = b""
+    # BLS proofs of possession, pubkey bytes -> PoP signature: the
+    # consensus-visible channel admitting genesis BLS keys to the
+    # aggregate-commit path (docs/AGGSIG.md "PoP policy"). Verified at
+    # State.from_genesis; a key with a bad/missing PoP still
+    # validates votes per-signature but can never join an aggregate.
+    bls_pops: dict = dc_field(default_factory=dict)
 
 
 @dataclass
@@ -99,6 +105,12 @@ class State:
     @classmethod
     def from_genesis(cls, gen: GenesisDoc) -> "State":
         """reference state/state.go MakeGenesisState."""
+        if gen.bls_pops:
+            # verify-and-register the genesis proofs of possession in
+            # one batched multi-pairing (idempotent + process-cached,
+            # so every node/restart in a process pays it once)
+            from ..aggsig.aggregate import register_pops_batch
+            register_pops_batch(gen.bls_pops)
         vals = ValidatorSet(gen.validators)
         return cls(
             chain_id=gen.chain_id,
@@ -252,21 +264,29 @@ class StateStore:
 
 
 def _valset_to_json(vs: ValidatorSet) -> bytes:
+    # key type stored per validator (absent == ed25519, so every state
+    # written before BLS valsets existed still loads): a BLS valset
+    # round-tripped through the store must come back as BLS keys, not
+    # be silently re-typed
     prop = vs.get_proposer()
     return json.dumps({
         "validators": [
             {"pub_key": v.pub_key.bytes_().hex(),
+             "type": v.pub_key.type_(),
              "power": v.voting_power,
              "priority": v.proposer_priority}
             for v in vs.validators],
         "proposer": prop.pub_key.bytes_().hex() if prop else None,
+        "proposer_type": prop.pub_key.type_() if prop else None,
     }).encode()
 
 
 def _valset_from_json(raw: bytes) -> ValidatorSet:
     d = json.loads(raw)
-    vals = [Validator(Ed25519PubKey(bytes.fromhex(v["pub_key"])),
-                      v["power"], v["priority"])
+    vals = [Validator(
+                pubkey_from_type_bytes(v.get("type", "ed25519"),
+                                       bytes.fromhex(v["pub_key"])),
+                v["power"], v["priority"])
             for v in d["validators"]]
     vs = ValidatorSet.__new__(ValidatorSet)
     vs.validators = vals
@@ -274,7 +294,9 @@ def _valset_from_json(raw: bytes) -> ValidatorSet:
     vs._total = None
     vs.proposer = None
     if d["proposer"] is not None:
-        addr = Ed25519PubKey(bytes.fromhex(d["proposer"])).address()
+        addr = pubkey_from_type_bytes(
+            d.get("proposer_type") or "ed25519",
+            bytes.fromhex(d["proposer"])).address()
         idx = vs._by_address.get(addr)
         vs.proposer = vals[idx] if idx is not None else None
     return vs

@@ -30,6 +30,11 @@ BLOCK_ID_FLAG_NIL = 3
 MAX_HEADER_BYTES = 626  # reference types/block.go MaxHeaderBytes
 BLOCK_PART_SIZE = 65536  # reference types/part_set.go BlockPartSizeBytes
 
+# Largest accepted vote/commit signature: 64B covers ed25519/secp/sr25519;
+# 96B is a compressed-G2 bls12_381 signature (the reference bumped
+# MaxSignatureSize the same way when BLS landed behind its build tag).
+MAX_SIGNATURE_SIZE = 96
+
 
 @dataclass(frozen=True)
 class PartSetHeader:
@@ -140,7 +145,7 @@ class CommitSig:
         else:
             if len(self.validator_address) != 20:
                 raise ValueError("validator address must be 20 bytes")
-            if not self.signature or len(self.signature) > 64:
+            if not self.signature or len(self.signature) > MAX_SIGNATURE_SIZE:
                 raise ValueError("signature absent or oversized")
 
 
@@ -221,6 +226,12 @@ class Commit:
     @classmethod
     def decode(cls, buf: bytes) -> "Commit":
         f = proto.parse_fields(buf)
+        if cls is Commit and 6 in f:
+            # aggregate seal present (agg_sig=6): dispatch to the
+            # AggregatedCommit wire form so every existing decode path
+            # (blockstore, block parts, WAL) round-trips it
+            from .agg_commit import AggregatedCommit
+            return AggregatedCommit.decode(buf)
         bid = proto.field_bytes(f, 3, None)
         return cls(proto.to_int64(proto.field_int(f, 1, 0)),
                    proto.to_int64(proto.field_int(f, 2, 0)),

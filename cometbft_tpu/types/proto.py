@@ -294,8 +294,12 @@ def cdc_int64(n: int) -> bytes:
 
 def public_key_proto(key_type: str, key_bytes: bytes) -> bytes:
     """cometbft.crypto.v1.PublicKey oneof: ed25519=1, secp256k1=2,
-    bls12381=3 (reference proto/cometbft/crypto/v1/keys.proto)."""
-    field = {"ed25519": 1, "secp256k1": 2, "bls12381": 3}[key_type]
+    bls12381=3 (reference proto/cometbft/crypto/v1/keys.proto).
+    "bls12_381" is crypto/bls12381.KEY_TYPE (const.go spells the wire
+    type string with the underscore); both spellings map to field 3 so
+    a BLS validator hashes instead of KeyError-ing mid-consensus."""
+    field = {"ed25519": 1, "secp256k1": 2,
+             "bls12381": 3, "bls12_381": 3}[key_type]
     return tag(field, _BYTES) + uvarint(len(key_bytes)) + key_bytes
 
 

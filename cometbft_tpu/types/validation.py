@@ -82,8 +82,17 @@ def _verify_basic(vals: ValidatorSet, commit: Commit, height: int,
 
 def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
     prop = vals.get_proposer()
-    return (len(commit.signatures) >= BATCH_VERIFY_THRESHOLD
-            and prop is not None
+    if prop is None:
+        return False
+    threshold = BATCH_VERIFY_THRESHOLD
+    if prop.pub_key.type_() == "bls12_381":
+        # BLS per-sig verification is pairing-bound (two Miller loops
+        # plus a final exponentiation EACH); the multi-pairing batch
+        # shares one final exponentiation across the whole set, so it
+        # pays for itself at the reference's own threshold of 2
+        # (types/validation.go:13) — no device dispatch involved.
+        threshold = 2
+    return (len(commit.signatures) >= threshold
             and crypto_batch.supports_batch_verifier(prop.pub_key))
 
 
@@ -95,11 +104,31 @@ def _verify_commit_core(chain_id: str, vals: ValidatorSet, commit: Commit,
     """Shared body of the batch and single paths
     (reference types/validation.go:218-322 and :331-405; one body here
     because attribution is free with per-lane verdicts)."""
+    from .agg_commit import AggregatedCommit
+    if isinstance(commit, AggregatedCommit):
+        # the BLS aggregate seal: one multi-pairing check for the whole
+        # commit (aggsig/verify.py), same ignore/count semantics and
+        # exception vocabulary, whole-aggregate verdict SigCache-keyed
+        from ..aggsig import verify as aggsig_verify
+        from ..pipeline.cache import shared_cache as _shared_cache
+        aggsig_verify.verify_aggregated_commit(
+            chain_id, vals, commit, voting_power_needed,
+            ignore=ignore, count=count, count_all=count_all,
+            lookup_by_index=lookup_by_index, cache=_shared_cache())
+        return
     use_batch = _should_batch_verify(vals, commit)
     bv = None
     if use_batch:
-        bv, ok = crypto_batch.create_batch_verifier(
-            vals.get_proposer().pub_key)
+        if len({v.pub_key.type_() for v in vals.validators}) > 1:
+            # heterogeneous valset: a proposer-keyed single-curve
+            # verifier would TypeError on the first foreign-curve
+            # lane; the mixed dispatcher buckets per curve (batched
+            # where supported, per-sig singles otherwise) with exact
+            # per-lane attribution
+            bv, ok = crypto_batch.MixedBatchVerifier(), True
+        else:
+            bv, ok = crypto_batch.create_batch_verifier(
+                vals.get_proposer().pub_key)
         use_batch = ok
 
     # verified-signature cache (pipeline/cache): commits re-checked by

@@ -98,7 +98,8 @@ class Vote:
             raise ValueError("bad validator address")
         if self.validator_index < 0:
             raise ValueError("negative validator index")
-        if not self.signature or len(self.signature) > 64:
+        from .block import MAX_SIGNATURE_SIZE
+        if not self.signature or len(self.signature) > MAX_SIGNATURE_SIZE:
             raise ValueError("signature missing or oversized")
 
     def encode(self) -> bytes:

@@ -415,8 +415,8 @@ class VoteSet:
 
     # --- commit construction -------------------------------------------------
 
-    def make_commit(self) -> Commit:
-        """Build the Commit sealing the maj23 block (reference
+    def _make_commit_plain(self) -> Commit:
+        """Per-lane-signature commit assembly (reference
         MakeExtendedCommit vote_set.go:635 + ExtendedCommit.ToCommit):
         one CommitSig slot per validator, absent where no usable vote."""
         if self.signed_msg_type != PRECOMMIT_TYPE:
@@ -436,11 +436,22 @@ class VoteSet:
         return Commit(height=self.height, round=self.round,
                       block_id=self.maj23, signatures=sigs)
 
+    def make_commit(self) -> Commit:
+        """Commit assembly. When the validator set is uniformly BLS
+        with registered proofs of possession, the for-block signatures
+        fold into the AggregatedCommit seal (one 96B aggregate + a
+        signer bitmap — types/agg_commit.py); every other valset gets
+        the plain per-lane form, byte-for-byte as before."""
+        from .agg_commit import maybe_aggregate
+        return maybe_aggregate(self._make_commit_plain(), self.val_set)
+
     def make_extended_commit(self) -> "ExtendedCommit":
         """Commit + the vote extensions that rode each precommit
-        (reference vote_set.go:635 MakeExtendedCommit)."""
+        (reference vote_set.go:635 MakeExtendedCommit). Always the
+        plain per-lane form: extensions pair with individual
+        signatures, never with the aggregate seal."""
         from .extended_commit import ExtendedCommit, ExtendedCommitSig
-        commit = self.make_commit()
+        commit = self._make_commit_plain()
         ext_sigs = []
         for cs, v in zip(commit.signatures, self.votes):
             if cs.for_block() and v is not None:

@@ -217,3 +217,22 @@ def test_device_health_seed_sweep_100():
     for r in results[50:]:
         assert any("state=quarantined" in ln for ln in r.log_lines), \
             (r.scenario, r.seed)
+
+
+def test_bls_valset_scenario():
+    """The aggregate-commit scenario: the real engine commits on a
+    uniformly-BLS valset with AggregatedCommit seals, a late joiner
+    blocksyncs through the AggSeal marshal route, sync-vs-aggregate
+    verdicts agree on every tamper class, and the combined log is
+    byte-identical across runs (the second run rides the process-wide
+    SigCache, so determinism costs little extra wall time)."""
+    a = run_scenario("bls-valset", 1, quick=True)
+    assert a.ok, a.failure_line()
+    assert a.max_height >= 2
+    assert any(line.startswith("agg_seal ") for line in a.log_lines)
+    equiv = {line.split()[1] for line in a.log_lines
+             if line.startswith("equiv ")}
+    assert {"case=clean", "case=tampered-sig", "case=signers-3",
+            "case=forged-bitmap", "case=undercount"} <= equiv
+    b = run_scenario("bls-valset", 1, quick=True)
+    assert b.digest == a.digest and b.log_lines == a.log_lines

@@ -48,6 +48,15 @@ python tools/bench_light.py --farm --clients 8 --blocks 12 \
 echo "=== flash-crowd quick sweep + ingest A/B smoke ===" >&2
 python tools/sim_run.py --scenario flash-crowd --seeds 0..4 --quick || rc=$?
 python tools/bench_ingest.py --clients 64 --rounds 2 --json || rc=$?
+# aggsig: the bls-valset sweep pins the aggregate-commit engine run
+# byte-identical per seed WITH sync-vs-aggregate verdict equivalence
+# (clean / tampered / forged-bitmap / undercount); the bench smoke
+# proves the O(1)-pairings-per-commit A/B still emits (tiny config —
+# the PERF.md datum is the 200-validator run)
+echo "=== bls-valset quick sweep + aggsig A/B smoke ===" >&2
+python tools/sim_run.py --scenario bls-valset --seeds 0..2 --quick || rc=$?
+BENCH_AGG_VALS=20 BENCH_AGG_BLOCKS=2 BENCH_AGG_SAMPLE=2 \
+    python bench.py --aggsig || rc=$?
 # suite 2/2 already covers the slow-marked pipeline soak on a default
 # (unfiltered) run; this explicit step guarantees the depth sweep even
 # when the caller filtered the main suites (e.g. -m 'not slow'), so no

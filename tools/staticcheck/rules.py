@@ -208,14 +208,17 @@ class ReactorSleepRule(Rule):
     time (simnet) and the event loop alike — use the ticker /
     timesource seams or an event wait."""
     name = "reactor-sleep"
-    doc = ("time.sleep() in consensus//pipeline//engine//farm//ingest "
-           "— use the ticker seam, an Event wait, or the async form")
+    doc = ("time.sleep() in consensus//pipeline//engine//farm//ingest//"
+           "aggsig — use the ticker seam, an Event wait, or the async "
+           "form")
     # farm/ and ingest/: RPC worker threads block on batcher/ticket
     # Events; a raw sleep there would both stall coalescing and break
-    # the light-farm / flash-crowd scenarios' determinism
+    # the light-farm / flash-crowd scenarios' determinism. aggsig/:
+    # commit verification runs inline in consensus handlers and the
+    # blocksync marshal stage — a sleep there stalls the round
     roots = ("cometbft_tpu/consensus", "cometbft_tpu/pipeline",
              "cometbft_tpu/engine", "cometbft_tpu/farm",
-             "cometbft_tpu/ingest")
+             "cometbft_tpu/ingest", "cometbft_tpu/aggsig")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
@@ -397,14 +400,16 @@ class BareExceptRule(Rule):
     KeyboardInterrupt/SystemExit and masks wedge signatures the
     watchdog and supervisor key off — name the exceptions."""
     name = "bare-except"
-    doc = ("bare `except:` in device/, pipeline/, farm/, or ingest/ — "
-           "catch named exception types so wedge/corruption signals "
-           "propagate")
+    doc = ("bare `except:` in device/, pipeline/, farm/, ingest/, or "
+           "aggsig/ — catch named exception types so wedge/corruption "
+           "signals propagate")
     # farm/ and ingest/ dispatch through the same device seam: a
     # swallowed canary/transport signal would hide corruption from the
-    # supervisor
+    # supervisor; aggsig/'s FinalExpChecker rides the same canary/
+    # quarantine discipline
     roots = ("cometbft_tpu/device", "cometbft_tpu/pipeline",
-             "cometbft_tpu/farm", "cometbft_tpu/ingest")
+             "cometbft_tpu/farm", "cometbft_tpu/ingest",
+             "cometbft_tpu/aggsig")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
