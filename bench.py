@@ -530,6 +530,7 @@ def main():
               file=sys.stderr, flush=True)
         return 1
     child_env_extra = {}
+    cpu_clamp = None
     if fallback_reason:
         _log(f"falling back to attributed CPU measurement "
              f"({fallback_reason})")
@@ -539,8 +540,27 @@ def main():
         platform = "cpu"
         # the XLA:CPU compile hazard (docs/PERF.md): batches >=256 can
         # crash the compiler outright and even 256 pays minutes —
-        # clamp to the 64-lane CPU bucket the tree already uses
-        batch = min(batch, 64)
+        # clamp to the 64-lane CPU bucket the tree already uses,
+        # UNLESS the compile ledger proves this (kernel, bucket)
+        # already compiled CLEANLY on this platform/jax build: then
+        # the measure child pays the known, recorded compile_s (still
+        # bounded by BENCH_MEASURE_TIMEOUT) instead of being pinned to
+        # tiny tiles forever (ROADMAP item-5 residual). A ledger miss
+        # or a crash verdict keeps the old clamp.
+        # the lookup must use the CHILD's platform key ("cpu"): the
+        # parent may still be configured for the device platform, and
+        # a device entry for the same (kernel, batch) must never lift
+        # the CPU clamp
+        from cometbft_tpu.libs.jax_cache import ledger as _lg
+        if batch > 64 and _lg().seen(
+                f"rlc-{os.environ.get('BENCH_KERNEL', 'xla')}", batch,
+                platform="cpu"):
+            cpu_clamp = "lifted-ledger-warm"
+            _log(f"64-lane CPU clamp lifted: ledger shows a clean "
+                 f"compile for batch={batch} on this platform")
+        else:
+            cpu_clamp = "clamped-64"
+            batch = min(batch, 64)
 
     # measurement runs in a child per batch attempt: a compiler crash
     # falls back to the next smaller batch (the RLC equation amortizes
@@ -567,7 +587,11 @@ def main():
             if time.monotonic() > deadline:
                 _log("total bench budget exhausted")
                 return 1
-            if ledger().known_crash(f"rlc-{which}", b):
+            # key under the platform the measure CHILD runs on — in
+            # fallback mode the parent is still device-configured
+            child_platform = "cpu" if fallback_reason else None
+            if ledger().known_crash(f"rlc-{which}", b,
+                                    platform=child_platform):
                 # the compile ledger remembers this (kernel, bucket)
                 # killed the compiler on this platform/jax build —
                 # skip straight to the next shape instead of paying
@@ -598,10 +622,13 @@ def main():
             if r.returncode == 0 and line:
                 if fallback_reason:
                     # attribute the fallback in the emitted record so
-                    # a CPU number is never mistaken for the headline
+                    # a CPU number is never mistaken for the headline;
+                    # cpu_clamp records whether the 64-lane clamp held
+                    # or was lifted by a warm ledger bucket
                     rec = json.loads(line)
                     rec["backend"] = "cpu"
                     rec["fallback_reason"] = fallback_reason
+                    rec["cpu_clamp"] = cpu_clamp
                     line = json.dumps(rec)
                 print(line, flush=True)
                 return 0
@@ -609,7 +636,8 @@ def main():
                 # compiler crash (SIGSEGV et al): remember the bucket
                 # so future rounds skip it without re-crashing
                 ledger().record_crash(f"rlc-{which}", b,
-                                      f"signal {-r.returncode}")
+                                      f"signal {-r.returncode}",
+                                      platform=child_platform)
             _log(f"measure[{b},{which}] failed rc={r.returncode} "
                  f"(signal="
                  f"{-r.returncode if r.returncode < 0 else 'none'}); "

@@ -169,20 +169,29 @@ class Ed25519BatchVerifier:
             return False, []
         n = len(self._pubs)
         eff = self._batch_size or 1 << (n - 1).bit_length()
-        from ..libs.jax_cache import is_device_platform
-        if not is_device_platform() and eff > 64:
+        from ..libs.jax_cache import is_device_platform, ledger
+        if not is_device_platform() and eff > 64 \
+                and not ledger().warm_in_process("ed25519-rlc", eff):
             # CPU backend: jitting the RLC kernel at batch >= 256
             # takes minutes and can crash the XLA:CPU compiler
             # (docs/PERF.md); a >64-lane flush on a CPU node runs the
             # native per-sig verify instead — the same clamp blocksync
-            # applies (engine/blocksync.py:79-89)
+            # applies (engine/blocksync.py:79-89). The clamp LIFTS
+            # when this process already compiled the bucket (node
+            # prewarm, or an earlier flush through this verifier): the
+            # warm jit cache makes the wide kernel the cheaper path
+            # (ROADMAP item-5 residual). Process-local warmth only —
+            # XLA:CPU executables are never persisted, so another
+            # process's ledger entry predicts a full recompile, not a
+            # reload (libs/jax_cache.warm_in_process).
             oks = [Ed25519PubKey(p).verify_signature(m, s)
                    for p, m, s in zip(self._pubs, self._msgs,
                                       self._sigs)]
             return all(oks), oks
         from ..ops.ed25519 import verify_batch
-        out = verify_batch(self._pubs, self._msgs, self._sigs,
-                           batch_size=self._batch_size)
+        with ledger().compile_guard("ed25519-rlc", eff):
+            out = verify_batch(self._pubs, self._msgs, self._sigs,
+                               batch_size=self._batch_size)
         oks = [bool(v) for v in out]
         return all(oks), oks
 

@@ -145,6 +145,14 @@ class DeviceSupervisor:
     pipeline thread, consensus verify paths, and `shared_client()`
     reconnects all report here)."""
 
+    # guarded-by: _lock: _state, _trips_since_healthy, _next_probe_at
+    # guarded-by: _lock: trips, probes, quarantines, canary_failures
+    # guarded-by: _lock: last_error
+    # (flow-aware: _set_state/_emit_state are only ever called under
+    # the lock, so they carry it at entry; the read-only state
+    # accessors below pragma their deliberate lock-free single-int
+    # reads)
+
     def __init__(self, backoff_base_s: Optional[float] = None,
                  backoff_cap_s: Optional[float] = None,
                  probe_deadline_s: Optional[float] = None,
@@ -187,22 +195,28 @@ class DeviceSupervisor:
 
     # --- introspection ----------------------------------------------------
 
+    # The accessors below read _state WITHOUT the lock on purpose: a
+    # single aligned int read is atomic under the GIL, the value is a
+    # snapshot that can be stale one instruction later regardless, and
+    # these sit on the per-batch dispatch hot path where serializing
+    # against report_* would add contention for no correctness gain.
+
     @property
     def state(self) -> int:
-        return self._state
+        return self._state  # staticcheck: allow(guarded-by)
 
     def state_name(self) -> str:
-        return STATE_NAMES[self._state]
+        return STATE_NAMES[self._state]  # staticcheck: allow(guarded-by)
 
     def healthy(self) -> bool:
-        return self._state == HEALTHY
+        return self._state == HEALTHY  # staticcheck: allow(guarded-by)
 
     def quarantined(self) -> bool:
-        return self._state == QUARANTINED
+        return self._state == QUARANTINED  # staticcheck: allow(guarded-by)
 
     def can_dispatch(self) -> bool:
         """True iff full batches may go to the device right now."""
-        return self._state == HEALTHY
+        return self._state == HEALTHY  # staticcheck: allow(guarded-by)
 
     # --- configuration (node boot; first caller wins) ---------------------
 
@@ -212,7 +226,10 @@ class DeviceSupervisor:
         supervisor, exactly like pipeline/cache.shared_cache)."""
         if metrics is not None and self.metrics is None:
             self.metrics = metrics
-            self._emit_state()
+            # under the lock: _emit_state reads _state, and boot-time
+            # configure can race a supervisor already fielding reports
+            with self._lock:
+                self._emit_state()
         if device_config is None or self._configured:
             return
         self._configured = True

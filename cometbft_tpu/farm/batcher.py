@@ -144,14 +144,24 @@ def device_or_cpu_backend(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
         if not ok:
             sup.report_corruption("farm batch canary mismatch")
             return _native_verify(lanes)
+        sup.report_success()
+        return [bool(v) for v in oks], "device"
     sup.report_success()
+    # the operator turned canary splicing OFF (COMETBFT_TPU_DEVICE_CANARY=0
+    # / [device] canary=false): verdicts are deliberately trusted un-gated
+    # in that configuration — the explicit, reviewed opt-out
+    # staticcheck: allow(verdict-taint)
     return [bool(v) for v in oks], "device"
 
 
 class FarmBatcher:
     """Bounded, coalescing, deduplicating verify queue."""
 
-    # guarded-by: _lock: _tickets, _pending_lanes
+    # guarded-by: _lock: _tickets, _pending_lanes, shed
+    # guarded-by: _flush_lock: batches, dedup_batch_hits, lanes_by_backend
+    # guarded-by: _flush_lock: last_batch_width, max_batch_width
+    # (flow-aware: _run_batch only runs from flush() under _flush_lock,
+    # so the batch stats it mutates are serialized by that lock)
 
     def __init__(self, cache: Optional[SigCache] = None,
                  max_pending_lanes: Optional[int] = None,

@@ -137,7 +137,10 @@ class TxTicket:
 class IngestPipeline:
     """Bounded, coalescing, deduplicating tx admission front door."""
 
-    # guarded-by: _lock: _tickets, _latencies
+    # guarded-by: _lock: _tickets, _latencies, shed, dup_hits
+    # (flow-aware: _shed_locked is only ever reached from submit()
+    # under `with self._lock`, so its shed/filter bookkeeping needs no
+    # pragma — the lock rides in from the caller)
 
     def __init__(self, mempool, cache: Optional[SigCache] = None,
                  batch: bool = True,
@@ -196,7 +199,10 @@ class IngestPipeline:
         t0 = self._clock()
         key = tx_key(tx)
         if not self.filter.push(key):
-            self.dup_hits += 1
+            # under the lock: concurrent RPC workers flooding the same
+            # tx would lose read-modify-write increments otherwise
+            with self._lock:
+                self.dup_hits += 1
             if self.metrics is not None:
                 self.metrics.dedup_hits.inc(kind="txhash")
             raise ValueError("tx already in cache")
@@ -391,12 +397,14 @@ class IngestPipeline:
 
     def stats(self) -> Dict:
         q = self.latency_quantiles()
+        with self._lock:
+            shed, dup_hits = self.shed, self.dup_hits
         return {
             "queued": self._queue_depth(),
             "admitted": self.dispatcher.admitted,
             "rejected": self.dispatcher.rejected,
-            "shed": self.shed,
-            "dup_hits": self.dup_hits,
+            "shed": shed,
+            "dup_hits": dup_hits,
             "batches": self.batcher.batches,
             "last_batch_width": self.batcher.last_batch_width,
             "max_batch_width": self.batcher.max_batch_width,

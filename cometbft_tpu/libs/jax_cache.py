@@ -116,13 +116,17 @@ class CompileLedger:
     another. All methods are best-effort on I/O errors: the ledger
     must never be able to fail a measurement run."""
 
-    # guarded-by: _lock: _entries, hits, misses
+    # guarded-by: _lock: _entries, hits, misses, _proc_warm
     def __init__(self, path: str | None = None):
         self.path = path or os.path.join(_REPO_ROOT, ".jax_cache",
                                          "ledger.json")
         self._lock = threading.Lock()
         self.hits = 0       # compile_guard entries already in the ledger
         self.misses = 0     # cold entries recorded this process
+        # keys THIS process compiled (or guarded through) — the only
+        # warmth that is cheap on XLA:CPU, where executables are never
+        # persisted and an on-disk entry predicts a full recompile
+        self._proc_warm: set = set()
         try:
             with open(self.path) as f:
                 self._entries: dict = json.load(f)
@@ -152,29 +156,48 @@ class CompileLedger:
             pass
 
     @staticmethod
-    def _env() -> str:
+    def _env(platform: str | None = None) -> str:
         try:
             import jax
             ver = jax.__version__
         except Exception:  # noqa: BLE001 — ledger must never fail callers
             ver = "?"
-        return f"{first_configured_platform() or 'cpu'}|{ver}"
+        return f"{platform or first_configured_platform() or 'cpu'}|{ver}"
 
-    def key(self, kernel: str, bucket: int) -> str:
-        return f"{kernel}|{bucket}|{self._env()}"
+    def key(self, kernel: str, bucket: int,
+            platform: str | None = None) -> str:
+        """Entry key; `platform` overrides the process's own configured
+        platform — bench's parent process must query/record under the
+        platform its MEASURE CHILD actually runs ('cpu' in the
+        device-unreachable fallback, while the parent may still be
+        configured for the device)."""
+        return f"{kernel}|{bucket}|{self._env(platform)}"
 
-    def seen(self, kernel: str, bucket: int) -> bool:
+    def seen(self, kernel: str, bucket: int,
+             platform: str | None = None) -> bool:
         with self._lock:
-            e = self._entries.get(self.key(kernel, bucket))
+            e = self._entries.get(self.key(kernel, bucket, platform))
         return bool(e) and not e.get("crashed")
 
-    def known_crash(self, kernel: str, bucket: int) -> bool:
+    def known_crash(self, kernel: str, bucket: int,
+                    platform: str | None = None) -> bool:
         with self._lock:
-            e = self._entries.get(self.key(kernel, bucket))
+            e = self._entries.get(self.key(kernel, bucket, platform))
         return bool(e) and bool(e.get("crashed"))
+
+    def warm_in_process(self, kernel: str, bucket: int) -> bool:
+        """True when THIS process already compiled (kernel, bucket) —
+        its jit cache makes the next dispatch to that bucket cheap.
+        This is deliberately NOT `seen()`: on cpu a ledger entry from
+        another process only predicts the recorded compile_s all over
+        again, so the 64-lane CPU clamp (crypto/keys) lifts on
+        process-local warmth alone."""
+        with self._lock:
+            return self.key(kernel, bucket) in self._proc_warm
 
     def record(self, kernel: str, bucket: int, compile_s: float) -> None:
         with self._lock:
+            self._proc_warm.add(self.key(kernel, bucket))
             self._entries[self.key(kernel, bucket)] = {
                 "kernel": kernel, "bucket": bucket,
                 "compile_s": round(float(compile_s), 3),
@@ -183,9 +206,10 @@ class CompileLedger:
             self._save(dict(self._entries))
 
     def record_crash(self, kernel: str, bucket: int,
-                     detail: str = "") -> None:
+                     detail: str = "",
+                     platform: str | None = None) -> None:
         with self._lock:
-            self._entries[self.key(kernel, bucket)] = {
+            self._entries[self.key(kernel, bucket, platform)] = {
                 "kernel": kernel, "bucket": bucket, "crashed": True,
                 "detail": detail[:200],
                 "recorded_unix": int(time.time()),  # staticcheck: allow(wallclock)
@@ -210,6 +234,7 @@ class CompileLedger:
                 self.hits += 1
             else:
                 self.misses += 1
+            self._proc_warm.add(self.key(kernel, bucket))
         if not warm:
             self.record(kernel, bucket, dt)
 

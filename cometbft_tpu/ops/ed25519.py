@@ -490,14 +490,19 @@ def prewarm_verify_kernels(batch_size: int = 4096,
     kernel genuinely compiles — corrupting R instead fails at
     decompression, which the structural mask attributes WITHOUT the
     fallback, leaving it cold until the first live failed batch."""
+    from ..libs.jax_cache import ledger
     pub, sig, msg = _dummy()
     bad = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
     pub_a, sig_a, hb, hn, _ = prepare_batch([pub], [msg], [sig],
                                             batch_size, msg_cap)
     z = make_rlc_coefficients(batch_size)
     # warm the kernel the live path will actually dispatch to (pallas
-    # on device platforms, with its own sticky XLA degradation)
-    _rlc_dispatch(pub_a, sig_a, hb, hn, z)
-    pub_a, sig_a, hb, hn, _ = prepare_batch([pub], [msg], [bad],
-                                            batch_size, msg_cap)
-    verify_kernel(pub_a, sig_a, hb, hn, zip215=True)
+    # on device platforms, with its own sticky XLA degradation). The
+    # compile guard attributes the warm in the ledger AND marks the
+    # bucket process-warm, which is what lifts the 64-lane CPU clamp
+    # in crypto/keys.Ed25519BatchVerifier for this bucket.
+    with ledger().compile_guard("ed25519-rlc", batch_size):
+        _rlc_dispatch(pub_a, sig_a, hb, hn, z)
+        pub_a, sig_a, hb, hn, _ = prepare_batch([pub], [msg], [bad],
+                                                batch_size, msg_cap)
+        verify_kernel(pub_a, sig_a, hb, hn, zip215=True)

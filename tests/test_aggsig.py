@@ -652,3 +652,29 @@ def test_blocksync_plain_bls_commits(plain_chain):
                          plain_chain.chain_id, tile_size=4, batch_size=0)
     st = r.sync(st)
     assert st.last_block_height == plain_chain.max_height()
+
+
+def test_ledger_platform_override_keys(tmp_path):
+    """bench's parent process queries/records under the platform its
+    measure CHILD runs on: an entry recorded under 'cpu' must be
+    visible via platform='cpu' regardless of the parent's own
+    configured platform, and a device entry must never satisfy a
+    cpu-keyed lookup."""
+    import os as _os
+    from cometbft_tpu.libs.jax_cache import CompileLedger
+
+    path = _os.path.join(str(tmp_path), "ledger.json")
+    led = CompileLedger(path)
+    cpu_key = led.key("rlc-xla", 256, platform="cpu")
+    dev_key = led.key("rlc-xla", 256, platform="axon")
+    assert cpu_key != dev_key and "|cpu|" in cpu_key
+
+    # write a cpu-keyed entry the way the measure child does
+    led._entries[cpu_key] = {"kernel": "rlc-xla", "bucket": 256,
+                             "compile_s": 1.0}
+    assert led.seen("rlc-xla", 256, platform="cpu")
+    assert not led.seen("rlc-xla", 256, platform="axon")
+    assert not led.known_crash("rlc-xla", 256, platform="cpu")
+    led.record_crash("rlc-xla", 512, "signal 11", platform="cpu")
+    assert led.known_crash("rlc-xla", 512, platform="cpu")
+    assert not led.known_crash("rlc-xla", 512, platform="axon")

@@ -122,3 +122,65 @@ def test_oversized_batch_chunks():
     sigs[3] = bytes(64)
     got = verify_batch([pub] * 5, msgs, sigs, batch_size=2)
     assert list(got) == [True, True, True, False, True]
+
+
+def test_cpu_clamp_lifts_on_process_warm_bucket(tmp_path, monkeypatch):
+    """ROADMAP item-5 residual: the 64-lane CPU clamp in
+    Ed25519BatchVerifier lifts once THIS process compiled the bucket
+    (CompileLedger.warm_in_process) — and stays clamped both cold and
+    when only an on-disk entry from another process exists (XLA:CPU
+    executables are never persisted; a disk entry predicts a full
+    recompile)."""
+    import os
+    from cometbft_tpu.crypto import keys as K
+    from cometbft_tpu.libs import jax_cache
+    import cometbft_tpu.ops.ed25519 as ops_ed
+
+    path = os.path.join(str(tmp_path), "ledger.json")
+    jax_cache.reset_ledger(path)
+    try:
+        calls = {"kernel": 0}
+
+        def fake(pubs, msgs, sigs, batch_size=None, **kw):
+            calls["kernel"] += 1
+            return np.ones((len(pubs),), dtype=bool)
+
+        monkeypatch.setattr(ops_ed, "verify_batch", fake)
+        monkeypatch.setattr(jax_cache, "first_configured_platform",
+                            lambda: "cpu")
+
+        seed = b"\x07" * 32
+        pub = ref.pubkey_from_seed(seed)
+        msgs = [bytes([i]) for i in range(70)]
+        sigs = [ref.sign(seed, m) for m in msgs]
+
+        def flush():
+            bv = K.Ed25519BatchVerifier(batch_size=256)
+            for m, s in zip(msgs, sigs):
+                bv.add(K.Ed25519PubKey(pub), m, s)
+            return bv.verify()
+
+        ok, oks = flush()             # cold: clamped to native per-sig
+        assert ok and len(oks) == 70 and calls["kernel"] == 0
+
+        # an entry written by ANOTHER process: still clamped
+        other = jax_cache.CompileLedger(path)
+        other.record("ed25519-rlc", 256, 123.0)
+        jax_cache.reset_ledger(path)
+        assert jax_cache.ledger().seen("ed25519-rlc", 256)
+        ok, _ = flush()
+        assert calls["kernel"] == 0
+
+        # process-local warm (the prewarm/compile_guard path): lifted
+        with jax_cache.ledger().compile_guard("ed25519-rlc", 256):
+            pass
+        ok, oks = flush()
+        assert ok and len(oks) == 70 and calls["kernel"] == 1
+        # ...and a DIFFERENT bucket stays clamped
+        bv = K.Ed25519BatchVerifier(batch_size=512)
+        for m, s in zip(msgs, sigs):
+            bv.add(K.Ed25519PubKey(pub), m, s)
+        bv.verify()
+        assert calls["kernel"] == 1
+    finally:
+        jax_cache.reset_ledger()

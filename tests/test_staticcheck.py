@@ -356,20 +356,28 @@ def test_pragma_on_code_line_does_not_cover_next_line(tmp_path):
 
 
 def test_pragma_wrong_rule_does_not_suppress(tmp_path):
+    # the wallclock finding stands, AND the raw-env pragma is itself a
+    # stale-pragma finding (it suppresses nothing on that line)
     res = lint(tmp_path, {
         "cometbft_tpu/x.py":
             "import time\n"
             "t = time.monotonic()  # staticcheck: allow(raw-env)\n"})
-    assert names(res) == [("wallclock", "cometbft_tpu/x.py")]
+    assert sorted(names(res)) == [
+        ("stale-pragma", "cometbft_tpu/x.py"),
+        ("wallclock", "cometbft_tpu/x.py")]
 
 
 def test_pragma_has_no_wildcard(tmp_path):
-    # rules must be named explicitly; allow(all) is not a thing
+    # rules must be named explicitly; allow(all) is not a thing — the
+    # finding stands and the unknown rule name is flagged
     res = lint(tmp_path, {
         "cometbft_tpu/x.py":
             "import time\n"
             "t = time.monotonic()  # staticcheck: allow(all)\n"})
-    assert names(res) == [("wallclock", "cometbft_tpu/x.py")]
+    assert sorted(names(res)) == [
+        ("stale-pragma", "cometbft_tpu/x.py"),
+        ("wallclock", "cometbft_tpu/x.py")]
+    assert any("unknown rule" in f.message for f in res.findings)
 
 
 # --- baseline mechanics ---------------------------------------------------

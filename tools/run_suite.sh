@@ -19,9 +19,21 @@ SECOND=(tests/test_[p-z]*.py)
 rc=0
 # project-invariant lint first: cheapest check, and a new finding (or
 # a stale baseline entry) should fail the suite before any test burns
-# compile time (docs/STATICCHECK.md; fix, pragma, or --fix-baseline)
+# compile time (docs/STATICCHECK.md; fix, pragma, or --fix-baseline).
+# BUDGET: the v2 whole-program engine (call graph + lock-order +
+# verdict-taint + kernel-discipline) must stay under 60s for the full
+# tree or it silently makes the suite unrunnable — a breach fails the
+# suite; attribute the slow rule with `--format json` (rule_seconds).
 echo "=== staticcheck: project-invariant linter ===" >&2
+sc_t0=$(date +%s)
 python -m tools.staticcheck || rc=$?
+sc_dt=$(( $(date +%s) - sc_t0 ))
+if [ "$sc_dt" -gt 60 ]; then
+    echo "staticcheck BUDGET BREACH: full-tree analysis took ${sc_dt}s" \
+         "(> 60s) — bisect with: python -m tools.staticcheck" \
+         "--format json (rule_seconds)" >&2
+    rc=1
+fi
 echo "=== suite 1/2: ${#FIRST[@]} modules (a-o) ===" >&2
 python -m pytest "${FIRST[@]}" -q "${ARGS[@]+"${ARGS[@]}"}" || rc=$?
 echo "=== suite 2/2: ${#SECOND[@]} modules (p-z) ===" >&2

@@ -116,11 +116,31 @@ class FileCtx:
         directly above it, silences the finding. Rules must be named
         explicitly — there is deliberately no allow-everything
         wildcard."""
-        for allowed in (self.pragmas.get(f.line),
-                        self.comment_pragmas.get(f.line - 1)):
-            if allowed and f.rule in allowed:
-                return True
-        return False
+        return self.suppressing_pragma(f) is not None
+
+    def suppressing_pragma(self, f: Finding) -> Optional[int]:
+        """Line number of the pragma that silences this finding (None
+        when nothing does) — the runner's stale-pragma audit records
+        which pragmas actually earned their keep."""
+        allowed = self.pragmas.get(f.line)
+        if allowed and f.rule in allowed:
+            return f.line
+        allowed = self.comment_pragmas.get(f.line - 1)
+        if allowed and f.rule in allowed:
+            return f.line - 1
+        return None
+
+    def has_pragma(self, rule: str, line: int) -> bool:
+        """Does a pragma for `rule` cover source line `line`? Used by
+        whole-program rules that must honor an allow() at a location
+        OTHER than where the eventual finding is reported (e.g. a
+        deliberately un-canaried `return` inside a verify backend,
+        whose taint would otherwise surface at a far-away sink)."""
+        allowed = self.pragmas.get(line)
+        if allowed and rule in allowed:
+            return True
+        allowed = self.comment_pragmas.get(line - 1)
+        return bool(allowed and rule in allowed)
 
 
 @dataclass
@@ -129,10 +149,30 @@ class Result:
     suppressed: int = 0            # pragma-silenced count
     baselined: List[Finding] = field(default_factory=list)  # matched baseline
     stale_baseline: List[str] = field(default_factory=list)  # unmatched entries
+    # rule name -> wall seconds spent in check()+finalize() (the
+    # "(project-graph)" pseudo-entry is the shared symbol-table/call-
+    # graph build the whole-program rules ride) — run_suite/CI uses
+    # this to attribute a slow run to the rule that caused it
+    rule_seconds: Dict[str, float] = field(default_factory=dict)
+    # (path, line, rule) inventory of every allow() pragma seen
+    pragma_inventory: List[Tuple[str, int, str]] = field(
+        default_factory=list)
 
     @property
     def ok(self) -> bool:
         return not self.findings and not self.stale_baseline
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message} for f in self.findings],
+            "stale_baseline": list(self.stale_baseline),
+            "suppressed": self.suppressed,
+            "baselined": len(self.baselined),
+            "rule_seconds": {k: round(v, 4)
+                             for k, v in sorted(self.rule_seconds.items())},
+        }
 
 
 # --- baseline -------------------------------------------------------------
@@ -203,6 +243,9 @@ def _iter_py_files(root: str, roots: Tuple[str, ...]) -> List[str]:
     return sorted(set(out))
 
 
+STALE_PRAGMA_RULE = "stale-pragma"
+
+
 def run_checks(root: str,
                baseline_path: Optional[str] = None,
                rules: Optional[list] = None,
@@ -212,12 +255,13 @@ def run_checks(root: str,
 
     `baseline_path=None` uses tools/staticcheck/baseline.txt under
     `root` (absent file = empty baseline). `tree_rules=False` skips
-    whole-tree rules (fail-point registry, metrics drift) — used when
-    linting a path subset, where cross-file conclusions would be wrong.
-    `only_paths` restricts scanning to the given repo-relative files or
-    directory prefixes (posix separators) — files outside are never
-    parsed.
+    whole-tree rules (fail-point registry, metrics drift, the v2
+    whole-program families) — used when linting a path subset, where
+    cross-file conclusions would be wrong. `only_paths` restricts
+    scanning to the given repo-relative files or directory prefixes
+    (posix separators) — files outside are never parsed.
     """
+    import time as _time
     from . import rules as rules_mod
     # fresh instances every run: tree rules accumulate per-run state
     active = [cls() for cls in
@@ -228,6 +272,13 @@ def run_checks(root: str,
     result = Result()
     raw: List[Tuple[Finding, Optional[FileCtx]]] = []
     ctxs: Dict[str, FileCtx] = {}
+
+    def _timed(name: str, fn):
+        t0 = _time.perf_counter()
+        out = fn()
+        result.rule_seconds[name] = (result.rule_seconds.get(name, 0.0)
+                                     + _time.perf_counter() - t0)
+        return out
 
     scan_roots = tuple(sorted({top for r in active for top in r.roots}))
     for path in _iter_py_files(root, scan_roots):
@@ -246,12 +297,29 @@ def run_checks(root: str,
             continue
         ctxs[path] = ctx
         for rule in applicable:
-            for f in rule.check(ctx):
+            for f in _timed(rule.name, lambda r=rule: list(r.check(ctx))):
                 raw.append((f, ctx))
 
+    # whole-program layer: built once, shared by every rule whose
+    # finalize() wants project-wide resolution (lock-order, verdict-
+    # taint, kernel-discipline, flow-aware guarded-by)
+    project = None
+    if tree_rules and any(getattr(r, "needs_project", False)
+                          for r in active):
+        from . import graph as graph_mod
+        project = _timed("(project-graph)",
+                         lambda: graph_mod.build_project(root, ctxs))
+
     for rule in active:
-        for f in rule.finalize(root):
+        for f in _timed(rule.name,
+                        lambda r=rule: list(r.finalize(root, project))):
             raw.append((f, ctxs.get(f.path)))
+    # whole-program rules may honor a pragma at a line other than the
+    # eventual finding's (e.g. verdict-taint's allow() on a deliberate
+    # un-gated return) — count those as used so the stale audit agrees
+    rule_used: Set[Tuple[str, int, str]] = set()
+    for rule in active:
+        rule_used |= set(getattr(rule, "used_pragmas", ()))
 
     baseline = load_baseline(baseline_path
                              if baseline_path is not None
@@ -261,11 +329,59 @@ def run_checks(root: str,
     # one must fail, not ride the old entry. Deterministic consumption
     # order (path, line) so reruns agree on which site is "the" old one.
     matched: Set[str] = set()
+    used_pragmas: Set[Tuple[str, int, str]] = set()
     ordered = sorted(raw, key=lambda t: (t[0].path, t[0].line, t[0].rule))
+    deferred: List[Tuple[Finding, Optional[FileCtx]]] = []
     for f, ctx in ordered:
-        if ctx is not None and ctx.suppressed(f):
-            result.suppressed += 1
-            continue
+        if ctx is not None:
+            at = ctx.suppressing_pragma(f)
+            if at is not None:
+                result.suppressed += 1
+                used_pragmas.add((f.path, at, f.rule))
+                continue
+        deferred.append((f, ctx))
+
+    # stale-pragma audit (shrink-only, mirroring the baseline policy):
+    # an allow(<rule>) whose rule no longer fires on that line is dead
+    # weight that would silently swallow the NEXT regression there —
+    # it must be deleted. Only audited for rules that are active AND
+    # scan the file (a subset/--rule run must not brand every other
+    # rule's pragmas stale); a name matching no known rule is always a
+    # finding (it never suppressed anything and never will).
+    known = {cls.name for cls in rules_mod.ALL_RULES}
+    known.add(STALE_PRAGMA_RULE)
+    active_by_name = {r.name: r for r in active}
+    for path in sorted(ctxs):
+        ctx = ctxs[path]
+        for line in sorted(ctx.pragmas):
+            for rule_name in sorted(ctx.pragmas[line]):
+                result.pragma_inventory.append((path, line, rule_name))
+                if rule_name not in known:
+                    deferred.append((Finding(
+                        STALE_PRAGMA_RULE, path, line,
+                        f"pragma names unknown rule {rule_name!r} "
+                        f"(known: see --list-rules)",
+                        ctx.line_text(line)), ctx))
+                    continue
+                rule = active_by_name.get(rule_name)
+                if rule is None or not rule.applies_to(path):
+                    continue  # not audited this run
+                if getattr(rule, "tree_rule", False) and not tree_rules:
+                    continue
+                if getattr(rule, "needs_project", False) \
+                        and project is None:
+                    continue  # whole-program rule didn't really run
+                if (path, line, rule_name) not in used_pragmas \
+                        and (path, line, rule_name) not in rule_used:
+                    deferred.append((Finding(
+                        STALE_PRAGMA_RULE, path, line,
+                        f"stale pragma: allow({rule_name}) suppresses "
+                        f"nothing here — delete it (a dead allow() "
+                        f"would silently swallow the next regression "
+                        f"on this line)", ctx.line_text(line)), ctx))
+
+    for f, ctx in sorted(deferred,
+                         key=lambda t: (t[0].path, t[0].line, t[0].rule)):
         fp = f.fingerprint()
         if fp in baseline and fp not in matched:
             matched.add(fp)

@@ -2,6 +2,9 @@
 
     python -m tools.staticcheck                # full tree, exit 1 on findings
     python -m tools.staticcheck --list-rules
+    python -m tools.staticcheck --list-pragmas # allow() inventory
+    python -m tools.staticcheck --format json  # machine-readable + timings
+    python -m tools.staticcheck --rule lock-order --rule guarded-by
     python -m tools.staticcheck --fix-baseline # rewrite baseline to now
     python -m tools.staticcheck cometbft_tpu/p2p/switch.py  # subset
                                                # (tree rules skipped)
@@ -12,6 +15,7 @@ Exit codes: 0 clean, 1 findings or stale baseline entries, 2 usage.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import posixpath
 import sys
@@ -35,6 +39,17 @@ def main(argv=None) -> int:
                          "set (growth is visible in review — justify "
                          "every added entry)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-pragmas", action="store_true",
+                    help="inventory every `# staticcheck: allow(...)` "
+                         "in the tree (path:line rule | source line)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="NAME",
+                    help="run only this rule (repeatable) — bisect a "
+                         "slow or regressing rule; baseline entries "
+                         "for other rules are ignored, not stale")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json: findings + per-rule wall-time for "
+                         "run_suite/CI attribution")
     args = ap.parse_args(argv)
 
     root = os.path.abspath(args.root) if args.root else os.path.dirname(
@@ -42,8 +57,18 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         for cls in ALL_RULES:
-            print(f"{cls.name:14s} {cls.doc}")
+            print(f"{cls.name:17s} {cls.doc}")
         return 0
+
+    rules = None
+    if args.rule:
+        by_name = {cls.name: cls for cls in ALL_RULES}
+        unknown = [r for r in args.rule if r not in by_name]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+        rules = [by_name[r] for r in args.rule]
 
     if args.paths:
         # subset lint: per-file rules only, no baseline interaction
@@ -65,15 +90,38 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
             wanted.append(rel)
-        res = run_checks(root, baseline_path=os.devnull,
+        res = run_checks(root, baseline_path=os.devnull, rules=rules,
                          tree_rules=False, only_paths=wanted)
         res.stale_baseline = []
     else:
-        res = run_checks(root)
+        res = run_checks(root, rules=rules,
+                         baseline_path=None if rules is None
+                         else default_baseline_path(root))
+        if rules is not None:
+            # a --rule run never judged the other rules' baseline
+            # entries; only entries belonging to the active rules can
+            # be stale
+            active = {cls.name for cls in rules}
+            res.stale_baseline = [
+                fp for fp in res.stale_baseline
+                if fp.split("|", 1)[0] in active]
+
+    if args.list_pragmas:
+        for path, line, rule_name in res.pragma_inventory:
+            src = ""
+            try:
+                with open(os.path.join(root, path),
+                          encoding="utf-8") as fh:
+                    src = fh.read().splitlines()[line - 1].strip()
+            except (OSError, IndexError):
+                pass
+            print(f"{path}:{line}: allow({rule_name}) | {src}")
+        print(f"{len(res.pragma_inventory)} pragma(s)")
+        return 0
 
     if args.fix_baseline:
-        if args.paths:
-            print("--fix-baseline requires a full-tree run",
+        if args.paths or rules is not None:
+            print("--fix-baseline requires a full-tree, all-rules run",
                   file=sys.stderr)
             return 2
         bl_path = default_baseline_path(root)
@@ -84,16 +132,25 @@ def main(argv=None) -> int:
               f"stale removed)")
         return 0
 
+    if args.format == "json":
+        print(json.dumps(res.to_json(), indent=1))
+        return 0 if res.ok else 1
+
     for f in res.findings:
         print(f.render())
     for fp in res.stale_baseline:
         print(f"stale baseline entry (finding gone — delete the "
               f"line): {fp}")
-    n_checked = f"{len(ALL_RULES)} rules"
+    n_checked = (f"{len(rules)} of {len(ALL_RULES)} rules" if rules
+                 else f"{len(ALL_RULES)} rules")
     if res.ok:
+        slowest = max(res.rule_seconds.items(),
+                      key=lambda kv: kv[1], default=("-", 0.0))
         print(f"staticcheck: clean ({n_checked}, "
               f"{res.suppressed} pragma-allowed, "
-              f"{len(res.baselined)} baselined)")
+              f"{len(res.baselined)} baselined, "
+              f"{sum(res.rule_seconds.values()):.1f}s total, "
+              f"slowest rule {slowest[0]} {slowest[1]:.1f}s)")
         return 0
     print(f"staticcheck: {len(res.findings)} finding(s), "
           f"{len(res.stale_baseline)} stale baseline entr(y/ies) — "

@@ -1,0 +1,467 @@
+"""Project symbol table + call graph — the whole-program layer under
+staticcheck's v2 rule families (lock-order, verdict-taint,
+kernel-discipline).
+
+The PR-4 linter was strictly per-file: every rule saw one `ast` tree
+and that file's import aliases. The bugs that now matter (a lock taken
+in one method while a helper in another file takes the reverse pair;
+an un-canaried device verdict crossing three modules before it reaches
+`mempool.check_tx`) are invisible at that granularity. This module
+builds, once per full-tree run:
+
+  * a MODULE map        (repo path <-> dotted module name),
+  * a SYMBOL TABLE      (module-level functions, classes, methods),
+  * LIGHT TYPE FACTS    (parameter/return annotations that name project
+                         classes; `self.x = <ClassCall>()` attribute
+                         types; `self._backend = param or module_fn`
+                         callable attributes),
+  * a CALL RESOLVER     (name calls, module-attribute calls,
+                         `self.method()`, typed-receiver method calls,
+                         `len(obj)` -> `__len__`), with a conservative
+                         DYNAMIC fallback (`by_method_name`) for
+                         receivers nothing resolves — callers opt into
+                         it per rule, because for some analyses
+                         conservative means MORE edges (lock cycles)
+                         and for others it means FEWER assumptions
+                         (taint treats unresolved returns as clean and
+                         leans on the pinned seam tests instead).
+
+Everything is stdlib `ast`; resolution is best-effort and documented
+as such in docs/STATICCHECK.md — the rules built on top are tuned so
+that unresolved things fail SAFE for their particular question.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from . import FileCtx
+
+
+def module_name(path: str) -> str:
+    """Repo-relative posix path -> dotted module name."""
+    mod = path[:-3] if path.endswith(".py") else path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class FuncInfo:
+    """One function or method definition."""
+
+    __slots__ = ("qualname", "module", "path", "cls", "name", "node",
+                 "lineno", "ret_types")
+
+    def __init__(self, qualname: str, module: str, path: str,
+                 cls: Optional[str], name: str, node: ast.AST):
+        self.qualname = qualname      # mod.fn or mod.Class.fn
+        self.module = module
+        self.path = path
+        self.cls = cls                # class qualname (mod.Class) or None
+        self.name = name
+        self.node = node
+        self.lineno = getattr(node, "lineno", 1)
+        self.ret_types: Set[str] = set()   # project-class qualnames
+
+    @property
+    def is_method(self) -> bool:
+        return self.cls is not None
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<FuncInfo {self.qualname}>"
+
+
+class ClassInfo:
+    __slots__ = ("qualname", "module", "path", "name", "node", "bases",
+                 "methods", "attr_types", "attr_callables")
+
+    def __init__(self, qualname: str, module: str, path: str,
+                 name: str, node: ast.ClassDef):
+        self.qualname = qualname
+        self.module = module
+        self.path = path
+        self.name = name
+        self.node = node
+        self.bases: List[str] = []             # resolved class qualnames
+        self.methods: Dict[str, FuncInfo] = {}
+        # self.<attr> -> set of project-class qualnames it may hold
+        self.attr_types: Dict[str, Set[str]] = {}
+        # self.<attr> -> set of project FUNCTION qualnames it may hold
+        # (the `self._backend = verify_backend or device_or_cpu_backend`
+        # plugin-seam shape)
+        self.attr_callables: Dict[str, Set[str]] = {}
+
+
+class Project:
+    """Symbol table + call graph over one full-tree scan's FileCtx map."""
+
+    def __init__(self, root: str, ctxs: Dict[str, FileCtx]):
+        self.root = root
+        self.ctxs = ctxs
+        self.modules: Dict[str, str] = {}          # dotted module -> path
+        self.packages: Set[str] = set()
+        self.functions: Dict[str, FuncInfo] = {}   # qualname -> info
+        self.classes: Dict[str, ClassInfo] = {}    # qualname -> info
+        # per-path import resolution: local name -> dotted target (module
+        # OR symbol); built with RELATIVE import support, which FileCtx's
+        # own alias maps deliberately skip
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self.by_method_name: Dict[str, List[str]] = {}
+        self._build()
+
+    # --- construction -----------------------------------------------------
+
+    def _build(self) -> None:
+        for path in self.ctxs:
+            mod = module_name(path)
+            self.modules[mod] = path
+            parts = mod.split(".")
+            for i in range(1, len(parts)):
+                self.packages.add(".".join(parts[:i]))
+        for path, ctx in self.ctxs.items():
+            self._index_file(path, ctx)
+        # second pass: facts that need the full symbol table (base-class
+        # resolution, annotation types, attribute types/callables)
+        for cls in self.classes.values():
+            self._resolve_bases(cls)
+        for fn in self.functions.values():
+            fn.ret_types = self.annotation_types(
+                getattr(fn.node, "returns", None), fn.path)
+        for cls in self.classes.values():
+            self._infer_attr_facts(cls)
+
+    def _index_file(self, path: str, ctx: FileCtx) -> None:
+        mod = module_name(path)
+        imports: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imports[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(mod, path, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    target = f"{base}.{a.name}" if base else a.name
+                    imports[a.asname or a.name] = target
+        self.imports[path] = imports
+
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{mod}.{stmt.name}"
+                self._add_function(FuncInfo(qn, mod, path, None,
+                                            stmt.name, stmt))
+            elif isinstance(stmt, ast.ClassDef):
+                cqn = f"{mod}.{stmt.name}"
+                cls = ClassInfo(cqn, mod, path, stmt.name, stmt)
+                self.classes[cqn] = cls
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fi = FuncInfo(f"{cqn}.{item.name}", mod, path,
+                                      cqn, item.name, item)
+                        cls.methods[item.name] = fi
+                        self._add_function(fi)
+
+    def _add_function(self, fi: FuncInfo) -> None:
+        self.functions[fi.qualname] = fi
+        self.by_method_name.setdefault(fi.name, []).append(fi.qualname)
+
+    def _import_base(self, mod: str, path: str,
+                     node: ast.ImportFrom) -> Optional[str]:
+        """Dotted base the imported names hang off ('' for a bare
+        `from . import x` at a repo-root package)."""
+        if node.level == 0:
+            return node.module or ""
+        # relative: drop `level` trailing components of the importing
+        # module (packages import relative to themselves, modules
+        # relative to their parent — __init__ paths already collapsed
+        # by module_name, so a module drops level components and a
+        # package drops level - 1)
+        parts = mod.split(".")
+        is_pkg = path.endswith("__init__.py")
+        drop = node.level - (1 if is_pkg else 0)
+        if drop >= len(parts) and not (drop == len(parts) and is_pkg):
+            base_parts: List[str] = []
+        else:
+            base_parts = parts[: len(parts) - drop] if drop else parts
+        base = ".".join(base_parts)
+        if node.module:
+            base = f"{base}.{node.module}" if base else node.module
+        return base
+
+    def _resolve_bases(self, cls: ClassInfo) -> None:
+        for b in cls.node.bases:
+            qn = self._symbol_for_expr(b, cls.path)
+            if qn in self.classes:
+                cls.bases.append(qn)
+
+    # --- symbol lookup ----------------------------------------------------
+
+    def _symbol_for_expr(self, node: ast.AST, path: str) -> Optional[str]:
+        """Resolve a Name / dotted-Attribute EXPRESSION to a project
+        symbol's qualname (function, class, or module) via this file's
+        imports — no local-scope awareness (callers overlay that)."""
+        if isinstance(node, ast.Name):
+            target = self.imports.get(path, {}).get(node.id)
+            if target is None:
+                # module-local symbol?
+                mod = module_name(path)
+                local = f"{mod}.{node.id}"
+                if local in self.functions or local in self.classes:
+                    return local
+                return None
+            return target
+        if isinstance(node, ast.Attribute):
+            base = self._symbol_for_expr(node.value, path)
+            if base is None:
+                return None
+            return f"{base}.{node.attr}"
+        return None
+
+    # --- annotations and expression types ---------------------------------
+
+    def annotation_types(self, node: Optional[ast.AST],
+                         path: str) -> Set[str]:
+        """Project-class qualnames named by an annotation (through
+        Optional[...] / Union[...] / \"quoted\" forms)."""
+        out: Set[str] = set()
+        if node is None:
+            return out
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            try:
+                node = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return out
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+            for e in elts:
+                out |= self.annotation_types(e, path)
+            return out
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            qn = self._symbol_for_expr(node, path)
+            if qn in self.classes:
+                out.add(qn)
+            elif isinstance(node, ast.Name):
+                # unqualified name matching a unique project class (the
+                # common `client: DeviceClient` in the defining module)
+                mod = module_name(path)
+                local = f"{mod}.{node.id}"
+                if local in self.classes:
+                    out.add(local)
+        return out
+
+    def expr_types(self, node: ast.AST, func: FuncInfo,
+                   env: Optional[Dict[str, Set[str]]] = None) -> Set[str]:
+        """May-types (project-class qualnames) of an expression inside
+        `func`. `env` carries local-variable types the caller tracked."""
+        env = env or {}
+        if isinstance(node, ast.Name):
+            if node.id == "self" and func.cls:
+                return {func.cls}
+            if node.id in env:
+                return set(env[node.id])
+            ann = self._param_annotation(func, node.id)
+            if ann is not None:
+                return self.annotation_types(ann, func.path)
+            return set()
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                    and func.cls:
+                out: Set[str] = set()
+                for c in self._mro(func.cls):
+                    out |= self.classes[c].attr_types.get(node.attr, set())
+                return out
+            return set()
+        if isinstance(node, ast.Call):
+            return self.call_return_types(node, func, env)
+        if isinstance(node, ast.BoolOp):
+            out = set()
+            for v in node.values:
+                out |= self.expr_types(v, func, env)
+            return out
+        if isinstance(node, ast.IfExp):
+            return (self.expr_types(node.body, func, env)
+                    | self.expr_types(node.orelse, func, env))
+        if isinstance(node, ast.NamedExpr):
+            return self.expr_types(node.value, func, env)
+        if isinstance(node, ast.Await):
+            return self.expr_types(node.value, func, env)
+        return set()
+
+    def call_return_types(self, node: ast.Call, func: FuncInfo,
+                          env: Optional[Dict[str, Set[str]]] = None
+                          ) -> Set[str]:
+        out: Set[str] = set()
+        for qn in self.resolve_call(func, node, env):
+            if qn in self.classes:
+                out.add(qn)                      # constructor call
+            elif qn in self.functions:
+                out |= self.functions[qn].ret_types
+        return out
+
+    def _param_annotation(self, func: FuncInfo,
+                          name: str) -> Optional[ast.AST]:
+        args = getattr(func.node, "args", None)
+        if args is None:
+            return None
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a.arg == name:
+                return a.annotation
+        return None
+
+    def _mro(self, cqn: str) -> List[str]:
+        """Linearized project-local ancestry (self first; best-effort)."""
+        out: List[str] = []
+        stack = [cqn]
+        while stack:
+            c = stack.pop(0)
+            if c in out or c not in self.classes:
+                continue
+            out.append(c)
+            stack.extend(self.classes[c].bases)
+        return out
+
+    def lookup_method(self, cqn: str, name: str) -> Optional[FuncInfo]:
+        for c in self._mro(cqn):
+            m = self.classes[c].methods.get(name)
+            if m is not None:
+                return m
+        return None
+
+    # --- attribute facts --------------------------------------------------
+
+    def _infer_attr_facts(self, cls: ClassInfo) -> None:
+        for m in cls.methods.values():
+            for node in ast.walk(m.node):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets, value = [node.target], node.value
+                    if isinstance(node.target, ast.Attribute) and \
+                            isinstance(node.target.value, ast.Name) and \
+                            node.target.value.id == "self":
+                        for t in self.annotation_types(node.annotation,
+                                                       cls.path):
+                            cls.attr_types.setdefault(
+                                node.target.attr, set()).add(t)
+                if value is None:
+                    continue
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    for ty in self.expr_types(value, m):
+                        cls.attr_types.setdefault(t.attr, set()).add(ty)
+                    for fn in self._callable_targets(value, m):
+                        cls.attr_callables.setdefault(
+                            t.attr, set()).add(fn)
+
+    def _callable_targets(self, node: ast.AST,
+                          func: FuncInfo) -> Set[str]:
+        """Function qualnames an expression may evaluate to (plugin
+        seams: `verify_backend or device_or_cpu_backend`)."""
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            qn = self._symbol_for_expr(node, func.path)
+            return {qn} if qn in self.functions else set()
+        if isinstance(node, ast.BoolOp):
+            out: Set[str] = set()
+            for v in node.values:
+                out |= self._callable_targets(v, func)
+            return out
+        if isinstance(node, ast.IfExp):
+            return (self._callable_targets(node.body, func)
+                    | self._callable_targets(node.orelse, func))
+        return set()
+
+    # --- call resolution --------------------------------------------------
+
+    def resolve_call(self, func: FuncInfo, node: ast.Call,
+                     env: Optional[Dict[str, Set[str]]] = None,
+                     dynamic: bool = False) -> List[str]:
+        """Qualnames a call may land on: functions, methods, or CLASS
+        qualnames (constructor calls). `env` supplies local-variable
+        types. `dynamic=True` adds the same-method-name fallback for
+        attribute calls nothing else resolved — conservative
+        over-approximation, per-rule opt-in."""
+        fn = node.func
+        out: List[str] = []
+        if isinstance(fn, ast.Name):
+            if fn.id == "len" and node.args:
+                for t in self.expr_types(node.args[0], func, env):
+                    m = self.lookup_method(t, "__len__")
+                    if m is not None:
+                        out.append(m.qualname)
+                return out
+            qn = self._local_or_import(fn.id, func)
+            if qn is not None:
+                out.append(qn)
+            return out
+        if isinstance(fn, ast.Attribute):
+            # self.method(...)
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                    and func.cls:
+                m = self.lookup_method(func.cls, fn.attr)
+                if m is not None:
+                    return [m.qualname]
+                for c in self._mro(func.cls):
+                    for target in self.classes[c].attr_callables.get(
+                            fn.attr, ()):
+                        out.append(target)
+                if out:
+                    return sorted(set(out))
+            # typed receiver (local var, annotated param, self-attr)
+            for t in sorted(self.expr_types(fn.value, func, env)):
+                m = self.lookup_method(t, fn.attr)
+                if m is not None:
+                    out.append(m.qualname)
+            if out:
+                return sorted(set(out))
+            # module attribute:  alias.fn(...) / pkg.mod.fn(...)
+            qn = self._symbol_for_expr(fn, func.path)
+            if qn in self.functions or qn in self.classes:
+                return [qn]
+            if dynamic:
+                return sorted(set(self.by_method_name.get(fn.attr, ())))
+        return out
+
+    def _local_or_import(self, name: str,
+                         func: FuncInfo) -> Optional[str]:
+        # a def nested in the same module scope, a classmate at module
+        # level, or a from-import of a project symbol
+        mod = func.module
+        for cand in (f"{mod}.{name}",):
+            if cand in self.functions or cand in self.classes:
+                return cand
+        target = self.imports.get(func.path, {}).get(name)
+        if target and (target in self.functions
+                       or target in self.classes):
+            return target
+        return None
+
+    # --- convenience ------------------------------------------------------
+
+    def functions_in(self, path_prefix: str) -> List[FuncInfo]:
+        return [f for f in self.functions.values()
+                if f.path == path_prefix
+                or f.path.startswith(path_prefix.rstrip("/") + "/")]
+
+    def iter_calls(self, func: FuncInfo) -> Iterable[ast.Call]:
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Call):
+                yield node
+
+
+def build_project(root: str, ctxs: Dict[str, FileCtx]) -> Project:
+    return Project(root, ctxs)

@@ -1,0 +1,500 @@
+"""kernel-discipline — the ops/ int32-Montgomery contract, enforced.
+
+The BASELINE >=1M sigs/s path exists because every ops/ kernel obeys
+four rules the TPU layout depends on (ops/field.py's module
+docstring): all integer work stays in int32/uint32 (TPU emulates s64
+as u32 pairs), python ints never leak into traced code, control flow
+inside a trace is static (shapes/dtypes only — data-dependent branches
+either crash at trace time or silently unroll wrong, the r02
+shape-broadcast crash class), and host<->device boundaries pin their
+dtypes explicitly (`np.asarray` without a dtype makes platform-int64
+constants on linux). Until now that was convention; this rule walks
+the ops/ call graph from every `jax.jit` / `lax.scan` / `fori_loop` /
+`pallas_call` entry and enforces it on exactly the functions a trace
+can reach.
+
+TRACED SCOPE: entry functions' parameters are traced except
+`static_argnames`; tracedness propagates through call sites (an
+argument computed from traced values marks the callee's parameter
+traced, to fixpoint) — so `pt_decompress(pub, zip215=True)` keeps
+`zip215` static while `pub` stays traced. Values derived from
+`.shape` / `.ndim` / `.dtype` / `.size` / `len()` are STATIC (that is
+the supported way to branch). Functions defined inside a traced
+function (scan bodies, pallas kernels) are traced with all parameters.
+
+FLAGGED inside traced scope:
+  * `if`/`while` on a traced value        -> jnp.where / lax.cond
+  * `int()` / `float()` / `bool()` on a traced value
+  * any `int64` / `uint64` / `float64` dtype mention
+  * `np.asarray` / `np.array` without an explicit dtype= (and any
+    numpy materialization OF a traced value)
+  * arithmetic mixing a traced value with a python-int literal >= 2^31
+    (silent int64 promotion)
+
+Host-side helpers in ops/ that no entry reaches (batch marshalling,
+table precomputation, module constants) are deliberately out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from . import FileCtx, Finding
+
+OPS_PREFIX = "cometbft_tpu/ops/"
+
+_JIT_NAMES = {"jax.jit", "jax.api.jit"}
+_WRAP_ARGPOS = {          # callable-arg positions of tracing wrappers
+    "scan": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "pallas_call": (0,),
+    "cond": (1, 2),
+}
+_WRAP_MODULES = ("jax.lax", "jax", "jax.experimental.pallas",
+                 "jax.experimental.pallas.tpu")
+_BAD_DTYPES = {"int64", "uint64", "float64"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_COERCIONS = {"int", "float", "bool"}
+_INT32_MAX = 2 ** 31
+
+
+class _Fn:
+    """One analyzable function body: a project-level ops/ function or
+    a nested def inside one."""
+
+    __slots__ = ("key", "path", "node", "ctx", "parent", "nested",
+                 "traced_params", "analyzed_with")
+
+    def __init__(self, key: str, path: str, node, ctx: FileCtx,
+                 parent: Optional["_Fn"]):
+        self.key = key
+        self.path = path
+        self.node = node
+        self.ctx = ctx
+        self.parent = parent
+        self.nested: Dict[str, "_Fn"] = {}
+        self.traced_params: Set[str] = set()
+        self.analyzed_with: Optional[frozenset] = None
+
+    def params(self) -> List[str]:
+        a = self.node.args
+        return [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+class KernelDisciplineRule:
+    name = "kernel-discipline"
+    doc = ("int64/python-int/data-dependent-control-flow/unpinned-"
+           "dtype inside ops/ code reachable from a jax.jit / "
+           "lax.scan / pallas entry — the int32 TPU contract "
+           "(ops/field.py, docs/STATICCHECK.md)")
+    roots: Tuple[str, ...] = (OPS_PREFIX.rstrip("/"),)
+    exempt: frozenset = frozenset()
+    tree_rule = True
+    needs_project = True
+
+    def applies_to(self, path: str) -> bool:
+        if path in self.exempt:
+            return False
+        return path.startswith(OPS_PREFIX)
+
+    def check(self, ctx: FileCtx):
+        return ()
+
+    # --- helpers: name resolution against a file's imports ----------------
+
+    @staticmethod
+    def _dotted(ctx: FileCtx, node: ast.AST) -> Optional[str]:
+        """Fully-qualified dotted name of a Name/Attribute expression
+        via the file's import aliases ('jnp.int64' -> 'jax.numpy.int64')."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = ctx.from_imports.get(node.id)
+        if base is None:
+            mod = ctx.module_aliases.get(node.id)
+            base = mod if mod is not None else node.id
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    def _is_jit(self, ctx: FileCtx, fn: ast.AST) -> bool:
+        dn = self._dotted(ctx, fn)
+        return dn in _JIT_NAMES or dn == "jit" \
+            or (dn is not None and dn.endswith(".jit")
+                and dn.startswith("jax"))
+
+    def _wrap_positions(self, ctx: FileCtx,
+                        fn: ast.AST) -> Optional[Tuple[int, ...]]:
+        if not isinstance(fn, ast.Attribute) \
+                or fn.attr not in _WRAP_ARGPOS:
+            return None
+        base = self._dotted(ctx, fn.value)
+        if base is not None and any(
+                base == m or base.startswith(m + ".")
+                for m in _WRAP_MODULES):
+            return _WRAP_ARGPOS[fn.attr]
+        return None
+
+    @staticmethod
+    def _static_argnames(call: ast.Call) -> Set[str]:
+        out: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg != "static_argnames":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str):
+                        out.add(e.value)
+        return out
+
+    @staticmethod
+    def _callable_name(node: ast.AST) -> Optional[ast.AST]:
+        """The function expression inside a wrapper arg — unwraps
+        functools.partial(f, ...)."""
+        if isinstance(node, ast.Call) and node.args:
+            fn = node.func
+            nm = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if nm == "partial":
+                return node.args[0]
+            return None
+        return node
+
+    # --- the analysis -----------------------------------------------------
+
+    def finalize(self, root: str, project=None) -> Iterator[Finding]:
+        if project is None:
+            return
+        # registry of every ops/ function INCLUDING nested defs
+        fns: Dict[str, _Fn] = {}
+
+        def register(path: str, ctx: FileCtx, node, parent,
+                     prefix: str) -> None:
+            for child in (node.body if hasattr(node, "body") else ()):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    key = f"{prefix}.{child.name}"
+                    fn = _Fn(key, path, child, ctx,
+                             parent if isinstance(parent, _Fn) else None)
+                    fns[key] = fn
+                    if isinstance(parent, _Fn):
+                        parent.nested[child.name] = fn
+                    register(path, ctx, child, fn, key)
+                elif isinstance(child, ast.ClassDef):
+                    register(path, ctx, child, None,
+                             f"{prefix}.{child.name}")
+
+        ops_ctxs = {p: c for p, c in project.ctxs.items()
+                    if self.applies_to(p)}
+        for path, ctx in sorted(ops_ctxs.items()):
+            from .graph import module_name
+            register(path, ctx, ctx.tree, None, module_name(path))
+
+        # --- collect entries ---------------------------------------------
+        # (fn key, traced param names)
+        worklist: List[Tuple[_Fn, Set[str]]] = []
+
+        def local_lookup(scope: Optional[_Fn], ctx: FileCtx, path: str,
+                         name_node: ast.AST) -> Optional[_Fn]:
+            target = self._callable_name(name_node)
+            if not isinstance(target, ast.Name):
+                return None
+            name = target.id
+            s = scope
+            while s is not None:
+                if name in s.nested:
+                    return s.nested[name]
+                s = s.parent
+            from .graph import module_name
+            return fns.get(f"{module_name(path)}.{name}")
+
+        def entry(fn: _Fn, static: Set[str]) -> None:
+            traced = {p for p in fn.params()
+                      if p not in static and p != "self"}
+            worklist.append((fn, traced))
+
+        for path, ctx in sorted(ops_ctxs.items()):
+            # decorators
+            from .graph import module_name
+            for key, fn in list(fns.items()):
+                if fn.path != path:
+                    continue
+                for dec in fn.node.decorator_list:
+                    if self._is_jit(ctx, dec):
+                        entry(fn, set())
+                    elif isinstance(dec, ast.Call):
+                        inner = dec.args[0] if dec.args else None
+                        if self._is_jit(ctx, dec.func):
+                            entry(fn, self._static_argnames(dec))
+                        elif inner is not None and \
+                                self._is_jit(ctx, inner):
+                            entry(fn, self._static_argnames(dec))
+            # jit(...) calls anywhere in the file
+            enclosing: Dict[int, _Fn] = {}
+            for key, fn in fns.items():
+                if fn.path != path:
+                    continue
+                for sub in ast.walk(fn.node):
+                    enclosing.setdefault(id(sub), fn)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                scope = enclosing.get(id(node))
+                if self._is_jit(ctx, node.func) and node.args:
+                    target = local_lookup(scope, ctx, path,
+                                          node.args[0])
+                    if target is not None:
+                        static = self._static_argnames(node)
+                        traced = {p for p in target.params()
+                                  if p not in static and p != "self"}
+                        worklist.append((target, traced))
+                    continue
+                pos = self._wrap_positions(ctx, node.func)
+                if pos is not None:
+                    for i in pos:
+                        if i < len(node.args):
+                            target = local_lookup(scope, ctx, path,
+                                                  node.args[i])
+                            if target is not None:
+                                worklist.append(
+                                    (target, set(target.params())))
+
+        # --- reachability + traced-param propagation ----------------------
+        findings: List[Finding] = []
+        while worklist:
+            fn, traced = worklist.pop()
+            want = traced | fn.traced_params
+            key = frozenset(want)
+            if fn.analyzed_with == key:
+                continue
+            fn.traced_params = set(want)
+            fn.analyzed_with = key
+            for callee, callee_traced in self._analyze(
+                    project, fns, fn, findings):
+                worklist.append((callee, callee_traced))
+
+        seen = set()
+        for f in sorted(findings, key=lambda x: (x.path, x.line,
+                                                 x.message)):
+            k = (f.path, f.line, f.message)
+            if k not in seen:
+                seen.add(k)
+                yield f
+
+    # --- per-function traced walk -----------------------------------------
+
+    def _analyze(self, project, fns: Dict[str, _Fn], fn: _Fn,
+                 findings: List[Finding]
+                 ) -> List[Tuple[_Fn, Set[str]]]:
+        ctx = fn.ctx
+        traced: Set[str] = set(fn.traced_params)
+        out_calls: List[Tuple[_Fn, Set[str]]] = []
+        # resolution context: a nested def (scan body, pallas kernel)
+        # is not in the project symbol table — climb to the enclosing
+        # module-level function/method, whose file-scope imports and
+        # module are identical
+        pinfo = project.functions.get(fn.key)
+        climb = fn
+        while pinfo is None and climb.parent is not None:
+            climb = climb.parent
+            pinfo = project.functions.get(climb.key)
+
+        def is_traced(node: ast.AST) -> bool:
+            if isinstance(node, ast.Name):
+                return node.id in traced
+            if isinstance(node, ast.Attribute):
+                if node.attr in _STATIC_ATTRS:
+                    return False
+                return is_traced(node.value)
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "len":
+                    return False
+                for a in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    if is_traced(a):
+                        return True
+                if isinstance(f, ast.Attribute):
+                    return is_traced(f.value)
+                return False
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                return False
+            return any(is_traced(c) for c in ast.iter_child_nodes(node))
+
+        def flag(node: ast.AST, msg: str) -> None:
+            findings.append(ctx.finding(self.name, node, msg))
+
+        def resolve_callee(call: ast.Call) -> List[_Fn]:
+            t = call.func
+            got: List[_Fn] = []
+            if isinstance(t, ast.Name):
+                local = None
+                s: Optional[_Fn] = fn
+                while s is not None:
+                    if t.id in s.nested:
+                        local = s.nested[t.id]
+                        break
+                    s = s.parent
+                if local is not None:
+                    return [local]
+            if pinfo is not None:
+                for q in project.resolve_call(pinfo, call):
+                    target = fns.get(q)
+                    if target is not None:
+                        got.append(target)
+            return got
+
+        def branch_traced(test: ast.AST) -> bool:
+            # membership tests (`k not in acc`) stay python-side even
+            # when the container holds traced values — dict/set keys
+            # are static by construction in kernel code (a true
+            # `x in jnp_array` fails loudly at trace time anyway)
+            if isinstance(test, ast.Compare) and all(
+                    isinstance(op, (ast.In, ast.NotIn))
+                    for op in test.ops):
+                return False
+            return is_traced(test)
+
+        class V(ast.NodeVisitor):
+            def visit_If(self, node):         # noqa: N802
+                if branch_traced(node.test):
+                    flag(node, "data-dependent python `if` on a "
+                               "traced value — a trace can't branch "
+                               "on data; use jnp.where / lax.cond / "
+                               "lax.select")
+                self.generic_visit(node)
+
+            def visit_While(self, node):      # noqa: N802
+                if branch_traced(node.test):
+                    flag(node, "data-dependent python `while` on a "
+                               "traced value — use lax.while_loop / "
+                               "lax.fori_loop")
+                self.generic_visit(node)
+
+            def visit_IfExp(self, node):      # noqa: N802
+                if branch_traced(node.test):
+                    flag(node, "data-dependent conditional expression "
+                               "on a traced value — use jnp.where")
+                self.generic_visit(node)
+
+            def visit_Assign(self, node):     # noqa: N802
+                if is_traced(node.value):
+                    for t in node.targets:
+                        _mark_target(t, traced)
+                self.generic_visit(node)
+
+            def visit_AugAssign(self, node):  # noqa: N802
+                if is_traced(node.value) and \
+                        isinstance(node.target, ast.Name):
+                    traced.add(node.target.id)
+                self.generic_visit(node)
+
+            def visit_For(self, node):        # noqa: N802
+                if is_traced(node.iter):
+                    _mark_target(node.target, traced)
+                self.generic_visit(node)
+
+            def visit_Attribute(self, node):  # noqa: N802
+                if node.attr in _BAD_DTYPES:
+                    flag(node, f"{node.attr} in kernel code — ops/ is "
+                               f"int32/uint32 only (TPU emulates 64-"
+                               f"bit; ops/field.py layout contract)")
+                self.generic_visit(node)
+
+            def visit_Constant(self, node):   # noqa: N802
+                if isinstance(node.value, str) \
+                        and node.value in _BAD_DTYPES:
+                    flag(node, f"dtype string {node.value!r} in "
+                               f"kernel code — ops/ is int32/uint32 "
+                               f"only")
+
+            def visit_BinOp(self, node):      # noqa: N802
+                for a, b in ((node.left, node.right),
+                             (node.right, node.left)):
+                    if isinstance(a, ast.Constant) \
+                            and isinstance(a.value, int) \
+                            and not isinstance(a.value, bool) \
+                            and abs(a.value) >= _INT32_MAX \
+                            and is_traced(b):
+                        flag(node, f"python-int literal {a.value} in "
+                                   f"arithmetic with a traced value — "
+                                   f"promotes to int64; split into "
+                                   f"int32-safe limbs")
+                        break
+                self.generic_visit(node)
+
+            def visit_Call(self, node):       # noqa: N802
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in _COERCIONS \
+                        and node.args and is_traced(node.args[0]):
+                    flag(node, f"{f.id}() concretizes a traced value "
+                               f"— python-scalar leakage breaks the "
+                               f"trace (the r02 crash class)")
+                dn = KernelDisciplineRule._dotted(ctx, f)
+                if dn in ("numpy.asarray", "numpy.array"):
+                    if any(is_traced(a) for a in node.args):
+                        flag(node, "numpy materialization of a traced "
+                                   "value inside a kernel — keep it "
+                                   "jnp, or hoist to the host "
+                                   "boundary")
+                    elif not any(kw.arg == "dtype"
+                                 for kw in node.keywords):
+                        flag(node, "np.asarray/np.array without "
+                                   "dtype= in traced code — platform-"
+                                   "dependent int64 default; pin the "
+                                   "dtype")
+                # propagate tracedness into resolved callees
+                for callee in resolve_callee(node):
+                    cps = callee.params()
+                    t: Set[str] = set()
+                    for i, a in enumerate(node.args):
+                        if i < len(cps) and is_traced(a):
+                            t.add(cps[i])
+                    for kw in node.keywords:
+                        if kw.arg in cps and is_traced(kw.value):
+                            t.add(kw.arg)
+                    if not (t <= callee.traced_params
+                            and callee.analyzed_with is not None):
+                        out_calls.append((callee, t))
+                self.generic_visit(node)
+
+        V().visit(self.node_body_holder(fn))
+        return out_calls
+
+    @staticmethod
+    def node_body_holder(fn: _Fn) -> ast.AST:
+        # visit the function's own body only: nested defs are separate
+        # _Fn entries analyzed when reached (locally called with traced
+        # args, or force-traced when passed to a tracing wrapper)
+        return ast.Module(
+            body=[s for s in fn.node.body
+                  if not isinstance(s, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))],
+            type_ignores=[])
+
+
+def _mark_target(t: ast.AST, traced: Set[str]) -> None:
+    """Mark assignment-target base names traced — never a subscript
+    INDEX (`acc[k] = traced` taints acc, not k)."""
+    if isinstance(t, ast.Name):
+        traced.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _mark_target(e, traced)
+    elif isinstance(t, ast.Starred):
+        _mark_target(t.value, traced)
+    elif isinstance(t, (ast.Subscript, ast.Attribute)):
+        base = t.value
+        while isinstance(base, (ast.Subscript, ast.Attribute)):
+            base = base.value
+        if isinstance(base, ast.Name):
+            traced.add(base.id)

@@ -1,0 +1,580 @@
+"""Lock-discipline rule family: whole-program lock-order analysis and
+the flow-aware `# guarded-by` contract.
+
+Two rules, both riding the shared Project graph (graph.py):
+
+`lock-order` — extracts the project's lock-acquisition graph: a node
+per (owning scope, lock attribute), an edge A -> B wherever code
+acquires B (directly, or by calling a function that transitively
+acquires B) while holding A. Cycles are potential deadlocks (two
+threads entering the ring at different points), and a SELF-edge is a
+guaranteed one: every `threading.Lock` in this tree is non-reentrant.
+Lock sites are `with self.<attr>` / `with <typed-expr>.<attr>` /
+`with <module-name>` where the attribute/name contains "lock" — the
+tree's uniform convention. Unresolved receivers and dynamic dispatch
+are SKIPPED for edges: for deadlock detection, over-approximating
+edges manufactures false cycles, so the graph only asserts what it can
+resolve (the seams the convention tests pin cover the rest).
+
+`guarded-by` — the PR-4 lexical rule promoted to flow-aware. A class
+declares `# guarded-by: <lock>: attr, ...` in its body; every access
+to a declared attribute must happen while the lock is held. v2 computes
+each method's ENTRY-HELD set: a private, never-escaping method whose
+every intraclass call site runs under `with self._lock` is itself
+lock-held at entry — so `_shed_locked`-style helpers no longer need a
+pragma — while a method reachable both with and without the lock (or
+public, or passed as a callback, or called from another class) gets
+the empty entry set, and any guarded access inside it on a path that
+can skip the lock is a finding. Subset runs (no project graph) fall
+back to the PR-4 lexical check.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from . import FileCtx, Finding
+
+LockId = Tuple[str, str]      # (owning scope qualname, lock name)
+
+_GUARD_RE = re.compile(
+    r"#\s*guarded-by:\s*(\w+)\s*:\s*([A-Za-z_][A-Za-z0-9_,\s]*)")
+
+
+def _is_lockish(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+def _with_items_locks(node, func, project, env) -> List[LockId]:
+    """Lock ids acquired by one With statement's context managers."""
+    out: List[LockId] = []
+    for item in node.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) and _is_lockish(e.attr):
+            if isinstance(e.value, ast.Name) and e.value.id == "self" \
+                    and func.cls:
+                out.append((func.cls, e.attr))
+            elif project is not None:
+                for t in sorted(project.expr_types(e.value, func, env)):
+                    out.append((t, e.attr))
+        elif isinstance(e, ast.Name) and _is_lockish(e.id):
+            out.append((f"mod:{func.module}", e.id))
+    return out
+
+
+def _local_env(project, func) -> Dict[str, Set[str]]:
+    """Coarse local-variable type environment: one pass over the
+    function body in source order, so `client = shared_client()` then
+    `fut = client.submit(...)` resolves the chained method. Memoized
+    on the project — lock-order, guarded-by, and verdict-taint all
+    consume the same environments."""
+    cache = getattr(project, "_env_cache", None)
+    if cache is None:
+        cache = project._env_cache = {}
+    got = cache.get(func.qualname)
+    if got is not None:
+        return got
+    env: Dict[str, Set[str]] = {}
+    for node in ast.walk(func.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            t = project.expr_types(node.value, func, env)
+            if t:
+                env[node.targets[0].id] = (
+                    env.get(node.targets[0].id, set()) | t)
+    cache[func.qualname] = env
+    return env
+
+
+def _call_targets(project, func) -> Dict[int, List[str]]:
+    """id(Call node) -> resolved function qualnames, for EVERY call in
+    `func` (closures included — the edge walker analyzes those too,
+    just with an empty held set). Memoized on the project."""
+    cache = getattr(project, "_call_cache", None)
+    if cache is None:
+        cache = project._call_cache = {}
+    got = cache.get(func.qualname)
+    if got is not None:
+        return got
+    env = _local_env(project, func)
+    out: Dict[int, List[str]] = {}
+    for c in project.iter_calls(func):
+        tgt = [q for q in project.resolve_call(func, c, env)
+               if q in project.functions]
+        if tgt:
+            out[id(c)] = tgt
+    cache[func.qualname] = out
+    return out
+
+
+def _own_nodes(root: ast.AST):
+    """Walk a function's OWN body, never descending into nested
+    defs/lambdas: a closure's acquisitions belong to whoever eventually
+    CALLS it, not to the function that merely defines it (a callback
+    registered under a lock must not fabricate a lock edge)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class LockOrderRule:
+    """Cross-function lock-acquisition-order cycles (potential
+    deadlock) and non-reentrant self-acquisition."""
+
+    name = "lock-order"
+    doc = ("lock-acquisition cycle (or re-acquisition of a held "
+           "non-reentrant lock) across the project call graph — "
+           "potential deadlock; break the cycle or order the locks")
+    roots: Tuple[str, ...] = ("cometbft_tpu",)
+    exempt: frozenset = frozenset()
+    tree_rule = True
+    needs_project = True
+
+    def applies_to(self, path: str) -> bool:
+        if path in self.exempt:
+            return False
+        return any(path == top or path.startswith(top + "/")
+                   for top in self.roots)
+
+    def check(self, ctx: FileCtx):
+        return ()
+
+    def finalize(self, root: str, project=None) -> Iterator[Finding]:
+        if project is None:
+            return
+        funcs = [f for f in project.functions.values()
+                 if self.applies_to(f.path)]
+        reentrant = _reentrant_locks(project)
+        envs = {f.qualname: _local_env(project, f) for f in funcs}
+        # resolved: EVERY call (closures included) for the edge walker;
+        # own_calls/direct: the function's OWN statements only — a
+        # closure's acquisitions are charged to its eventual caller,
+        # never to the function that defines it
+        resolved: Dict[str, Dict[int, List[str]]] = {}
+        own_calls: Dict[str, List[List[str]]] = {}
+        direct: Dict[str, List[LockId]] = {}
+        for f in funcs:
+            env = envs[f.qualname]
+            resolved[f.qualname] = _call_targets(project, f)
+            own_calls[f.qualname] = [
+                resolved[f.qualname][id(n)]
+                for n in _own_nodes(f.node)
+                if isinstance(n, ast.Call)
+                and id(n) in resolved[f.qualname]]
+            direct[f.qualname] = [
+                lid for node in _own_nodes(f.node)
+                if isinstance(node, (ast.With, ast.AsyncWith))
+                for lid in _with_items_locks(node, f, project, env)]
+
+        # transitive acquisition summary, to fixpoint
+        acquires: Dict[str, Set[LockId]] = {
+            f.qualname: set(direct[f.qualname]) for f in funcs}
+        changed = True
+        while changed:
+            changed = False
+            for f in funcs:
+                acc = acquires[f.qualname]
+                before = len(acc)
+                for targets in own_calls[f.qualname]:
+                    for t in targets:
+                        acc |= acquires.get(t, set())
+                if len(acc) != before:
+                    changed = True
+
+        # edges: held-at-point -> acquired (direct or via a call)
+        edges: Dict[Tuple[LockId, LockId],
+                    Tuple[str, int, str]] = {}   # witness (path, line, via)
+
+        def note(a: LockId, b: LockId, path: str, line: int,
+                 via: str) -> None:
+            edges.setdefault((a, b), (path, line, via))
+
+        def walk(body, func, env, held: Tuple[LockId, ...]) -> None:
+            for node in body:
+                visit(node, func, env, held)
+
+        def visit(node, func, env, held) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                got = _with_items_locks(node, func, project, env)
+                for item in node.items:
+                    visit(item.context_expr, func, env, held)
+                for lid in got:
+                    for h in held:
+                        note(h, lid, func.path, node.lineno,
+                             f"acquires {lid[1]}")
+                inner = held + tuple(lid for lid in got
+                                     if lid not in held)
+                walk(node.body, func, env, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # a closure may run later, on another thread, without
+                # the enclosing locks — analyze it unlocked
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                walk(body, func, env, ())
+                return
+            if isinstance(node, ast.Call) and held:
+                for q in resolved[func.qualname].get(id(node), ()):
+                    for lid in acquires.get(q, ()):
+                        for h in held:
+                            note(h, lid, func.path, node.lineno,
+                                 f"call {q.rsplit('.', 1)[-1]}() "
+                                 f"acquires {lid[1]}")
+            for child in ast.iter_child_nodes(node):
+                visit(child, func, env, held)
+
+        for f in funcs:
+            walk(f.node.body, f, envs[f.qualname], ())
+
+        # self-edges: re-acquiring a held NON-REENTRANT lock wedges
+        # (an RLock/Condition re-entry is by design and skipped)
+        for (a, b), (path, line, via) in sorted(edges.items()):
+            if a == b and a not in reentrant:
+                yield Finding(
+                    self.name, path, line,
+                    f"{a[0].rsplit('.', 1)[-1]}.{a[1]} is re-acquired "
+                    f"while already held ({via}) — threading.Lock is "
+                    f"not reentrant; this deadlocks the thread")
+
+        # cycles (length >= 2): Tarjan SCC over the lock digraph
+        graph: Dict[LockId, Set[LockId]] = {}
+        for (a, b) in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        for scc in _sccs(graph):
+            if len(scc) < 2:
+                continue
+            ring = sorted(scc)
+            witnesses = sorted(
+                (edges[(a, b)], a, b) for (a, b) in edges
+                if a in scc and b in scc and a != b)
+            (path, line, _via), a, b = witnesses[0]
+            names = ", ".join(f"{s.rsplit('.', 1)[-1]}.{l}"
+                              for s, l in ring)
+            detail = "; ".join(
+                f"{wa[0].rsplit('.', 1)[-1]}.{wa[1]} -> "
+                f"{wb[0].rsplit('.', 1)[-1]}.{wb[1]} at {w[0]}:{w[1]}"
+                for w, wa, wb in witnesses[:4])
+            yield Finding(
+                self.name, path, line,
+                f"lock-order cycle: {{{names}}} — two threads entering "
+                f"this ring at different points deadlock ({detail})")
+
+
+def _reentrant_locks(project) -> Set[LockId]:
+    """(scope, name) pairs assigned from threading.RLock()/Condition()
+    — re-entrant by construction, so a self-edge is not a deadlock."""
+    out: Set[LockId] = set()
+
+    def is_rlockish(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        f = value.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        return name in ("RLock", "Condition")
+
+    for path, ctx in project.ctxs.items():
+        from .graph import module_name
+        mod = module_name(path)
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and is_rlockish(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add((f"mod:{mod}", t.id))
+            elif isinstance(node, ast.ClassDef):
+                cqn = f"{mod}.{node.name}"
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and is_rlockish(sub.value):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Attribute) \
+                                    and isinstance(t.value, ast.Name) \
+                                    and t.value.id == "self":
+                                out.add((cqn, t.attr))
+    return out
+
+
+def _sccs(graph: Dict[LockId, Set[LockId]]) -> List[Set[LockId]]:
+    """Iterative Tarjan strongly-connected components."""
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on: Set[LockId] = set()
+    stack: List[LockId] = []
+    out: List[Set[LockId]] = []
+    counter = [0]
+
+    for start in sorted(graph):
+        if start in index:
+            continue
+        work = [(start, iter(sorted(graph.get(start, ()))))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on.add(start)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp: Set[LockId] = set()
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.add(w)
+                    if w == v:
+                        break
+                out.append(comp)
+    return out
+
+
+class GuardedByRule:
+    """Flow-aware `# guarded-by: <lock>: attrs` contract (see module
+    docstring). Declared today across pipeline/cache, farm/session,
+    farm/batcher, farm/service, ingest/admission, device/health,
+    libs/jax_cache, p2p/switch, and aggsig/aggregate."""
+
+    name = "guarded-by"
+    doc = ("access to a `# guarded-by: <lock>: <attrs>`-declared "
+           "attribute on a path that can skip `with self.<lock>` "
+           "(flow-aware: helpers only ever called under the lock are "
+           "lock-held at entry; __init__ exempt)")
+    roots: Tuple[str, ...] = ("cometbft_tpu",)
+    exempt: frozenset = frozenset()
+    tree_rule = False          # subset runs still get the lexical check
+    needs_project = True
+
+    def __init__(self):
+        self._ctxs: List[FileCtx] = []
+        # callee method qualname -> caller class qualnames (None for
+        # module-level callers); computed once per run, shared by every
+        # declared class's entry-held analysis
+        self._ext_calls: Optional[Dict[str, Set[Optional[str]]]] = None
+
+    def applies_to(self, path: str) -> bool:
+        if path in self.exempt:
+            return False
+        return any(path == top or path.startswith(top + "/")
+                   for top in self.roots)
+
+    def check(self, ctx: FileCtx):
+        self._ctxs.append(ctx)
+        return ()
+
+    # --- declaration scan -------------------------------------------------
+
+    @staticmethod
+    def declared(ctx: FileCtx, cls: ast.ClassDef) -> Dict[str, str]:
+        """attr -> lock-attr, from guarded-by comments in the class
+        body's line span."""
+        attr_lock: Dict[str, str] = {}
+        end = getattr(cls, "end_lineno", cls.lineno) or cls.lineno
+        for ln in range(cls.lineno, end + 1):
+            m = _GUARD_RE.search(ctx.line_text(ln))
+            if m:
+                lock = m.group(1)
+                for attr in m.group(2).split(","):
+                    attr = attr.strip()
+                    if attr:
+                        attr_lock[attr] = lock
+        return attr_lock
+
+    # --- entry-held computation -------------------------------------------
+
+    def _entry_held(self, project, cinfo, locks: Set[str]
+                    ) -> Dict[str, FrozenSet[str]]:
+        """Lock set provably held when each method is entered.
+
+        Public methods, dunders, methods whose reference ESCAPES (read
+        as a value — callback registration, Thread target), and methods
+        resolvedly called from OUTSIDE the class start at the empty
+        set. Private intraclass-only methods start optimistic (all
+        declared locks) and shrink to the intersection over their call
+        sites' held sets, to fixpoint."""
+        methods = cinfo.methods
+        escaped: Set[str] = set()
+        for m in methods.values():
+            for node in ast.walk(m.node):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and node.attr in methods:
+                    if not self._is_call_func(m.node, node):
+                        escaped.add(node.attr)
+        externally_called: Set[str] = set()
+        if project is not None:
+            if self._ext_calls is None:
+                self._ext_calls = {}
+                for f in project.functions.values():
+                    for targets in _call_targets(project, f).values():
+                        for q in targets:
+                            self._ext_calls.setdefault(
+                                q, set()).add(f.cls)
+            for name in methods:
+                callers = self._ext_calls.get(
+                    f"{cinfo.qualname}.{name}", set())
+                if callers - {cinfo.qualname}:
+                    externally_called.add(name)
+
+        def optimistic(name: str) -> FrozenSet[str]:
+            if not name.startswith("_") or name.startswith("__"):
+                return frozenset()
+            if name in escaped or name in externally_called:
+                return frozenset()
+            return frozenset(locks)
+
+        entry = {n: optimistic(n) for n in methods}
+        for _ in range(len(methods) + 2):
+            sites: Dict[str, List[FrozenSet[str]]] = {n: []
+                                                      for n in methods}
+
+            def scan(body, held: FrozenSet[str]) -> None:
+                for node in body:
+                    scan_node(node, held)
+
+            def scan_node(node, held: FrozenSet[str]) -> None:
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    got = {item.context_expr.attr
+                           for item in node.items
+                           if isinstance(item.context_expr, ast.Attribute)
+                           and isinstance(item.context_expr.value,
+                                          ast.Name)
+                           and item.context_expr.value.id == "self"}
+                    scan(node.body, held | frozenset(got))
+                    return
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    body = node.body if isinstance(node.body, list) \
+                        else [node.body]
+                    scan(body, frozenset())
+                    return
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self" \
+                        and node.func.attr in methods:
+                    sites[node.func.attr].append(held)
+                for child in ast.iter_child_nodes(node):
+                    scan_node(child, held)
+
+            for name, m in methods.items():
+                scan(m.node.body, entry[name])
+            new = {}
+            for name in methods:
+                base = optimistic(name)
+                if base and sites[name]:
+                    inter = frozenset(locks)
+                    for h in sites[name]:
+                        inter &= h
+                    new[name] = inter
+                elif base and not sites[name]:
+                    # never called inside the class: nothing proves the
+                    # lock is held at entry
+                    new[name] = frozenset()
+                else:
+                    new[name] = base
+            if new == entry:
+                break
+            entry = new
+        return entry
+
+    @staticmethod
+    def _is_call_func(scope: ast.AST, target: ast.Attribute) -> bool:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call) and node.func is target:
+                return True
+        return False
+
+    # --- the walk ---------------------------------------------------------
+
+    def finalize(self, root: str, project=None) -> Iterator[Finding]:
+        for ctx in self._ctxs:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(ctx, node, project)
+
+    def _check_class(self, ctx: FileCtx, cls: ast.ClassDef,
+                     project) -> Iterator[Finding]:
+        attr_lock = self.declared(ctx, cls)
+        if not attr_lock:
+            return
+        entry: Dict[str, FrozenSet[str]] = {}
+        if project is not None:
+            from .graph import module_name
+            cqn = f"{module_name(ctx.path)}.{cls.name}"
+            cinfo = project.classes.get(cqn)
+            if cinfo is not None:
+                entry = self._entry_held(project, cinfo,
+                                         set(attr_lock.values()))
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and item.name != "__init__":
+                held = frozenset(entry.get(item.name, frozenset()))
+                yield from self._walk(ctx, item.body, attr_lock, held)
+
+    def _with_locks(self, node: ast.With) -> Set[str]:
+        got: Set[str] = set()
+        for item in node.items:
+            e = item.context_expr
+            if isinstance(e, ast.Attribute) \
+                    and isinstance(e.value, ast.Name) \
+                    and e.value.id == "self":
+                got.add(e.attr)
+        return got
+
+    def _walk(self, ctx: FileCtx, body, attr_lock: Dict[str, str],
+              held: FrozenSet[str]) -> Iterator[Finding]:
+        for node in body:
+            yield from self._visit(ctx, node, attr_lock, held)
+
+    def _visit(self, ctx: FileCtx, node: ast.AST,
+               attr_lock: Dict[str, str],
+               held: FrozenSet[str]) -> Iterator[Finding]:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held | self._with_locks(node)
+            # the with-items themselves (self._lock) are evaluated
+            # unlocked — fine, the lock attr is never a guarded attr
+            yield from self._walk(ctx, node.body, attr_lock, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure may run later, outside the lock — conservative
+            body = node.body if isinstance(node.body, list) else [node.body]
+            yield from self._walk(ctx, body, attr_lock, frozenset())
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr in attr_lock \
+                and attr_lock[node.attr] not in held:
+            yield ctx.finding(
+                self.name, node,
+                f"self.{node.attr} is declared guarded-by "
+                f"self.{attr_lock[node.attr]} but reachable outside "
+                f"`with self.{attr_lock[node.attr]}`")
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(ctx, child, attr_lock, held)

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import ast
 import os
-import re
 import subprocess
 import sys
 from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
@@ -30,6 +29,7 @@ class Rule:
     roots: Tuple[str, ...] = ("cometbft_tpu",)
     exempt: frozenset = frozenset()
     tree_rule = False
+    needs_project = False   # True: finalize() wants the Project graph
 
     def applies_to(self, path: str) -> bool:
         if path in self.exempt:
@@ -40,7 +40,7 @@ class Rule:
     def check(self, ctx: FileCtx) -> Iterable[Finding]:
         return ()
 
-    def finalize(self, root: str) -> Iterable[Finding]:
+    def finalize(self, root: str, project=None) -> Iterable[Finding]:
         return ()
 
 
@@ -235,94 +235,12 @@ class ReactorSleepRule(Rule):
                     "ticker / wait on an Event instead")
 
 
-_GUARD_RE = re.compile(
-    r"#\s*guarded-by:\s*(\w+)\s*:\s*([A-Za-z_][A-Za-z0-9_,\s]*)")
-
-
-class GuardedByRule(Rule):
-    """Static cousin of COMETBFT_TPU_THREAD_CHECK: a class may declare
-    `# guarded-by: _lock: attr, ...` in its body; every self.<attr>
-    read or write outside a `with self._lock:` block (and outside
-    __init__, which runs before the object is shared) is then a lint
-    error."""
-    name = "guarded-by"
-    doc = ("access to a `# guarded-by: <lock>: <attrs>`-declared "
-           "attribute outside `with self.<lock>` (and outside __init__)")
-
-    def check(self, ctx: FileCtx) -> Iterator[Finding]:
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.ClassDef):
-                yield from self._check_class(ctx, node)
-
-    def _declared(self, ctx: FileCtx,
-                  cls: ast.ClassDef) -> Dict[str, str]:
-        """attr -> lock-attr, from guarded-by comments in the class
-        body's line span."""
-        attr_lock: Dict[str, str] = {}
-        end = getattr(cls, "end_lineno", cls.lineno) or cls.lineno
-        for ln in range(cls.lineno, end + 1):
-            m = _GUARD_RE.search(ctx.line_text(ln))
-            if m:
-                lock = m.group(1)
-                for attr in m.group(2).split(","):
-                    attr = attr.strip()
-                    if attr:
-                        attr_lock[attr] = lock
-        return attr_lock
-
-    def _check_class(self, ctx: FileCtx,
-                     cls: ast.ClassDef) -> Iterator[Finding]:
-        attr_lock = self._declared(ctx, cls)
-        if not attr_lock:
-            return
-        for item in cls.body:
-            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and item.name != "__init__":
-                yield from self._walk(ctx, item.body, attr_lock,
-                                      held=frozenset())
-
-    def _with_locks(self, node: ast.With) -> Set[str]:
-        got: Set[str] = set()
-        for item in node.items:
-            e = item.context_expr
-            if isinstance(e, ast.Attribute) \
-                    and isinstance(e.value, ast.Name) \
-                    and e.value.id == "self":
-                got.add(e.attr)
-        return got
-
-    def _walk(self, ctx: FileCtx, body, attr_lock: Dict[str, str],
-              held: frozenset) -> Iterator[Finding]:
-        for node in body:
-            yield from self._visit(ctx, node, attr_lock, held)
-
-    def _visit(self, ctx: FileCtx, node: ast.AST,
-               attr_lock: Dict[str, str],
-               held: frozenset) -> Iterator[Finding]:
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            inner = held | self._with_locks(node)
-            # the with-items themselves (self._lock) are evaluated
-            # unlocked — fine, the lock attr is never a guarded attr
-            yield from self._walk(ctx, node.body, attr_lock, inner)
-            return
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            # a closure may run later, outside the lock — conservative
-            body = node.body if isinstance(node.body, list) else [node.body]
-            yield from self._walk(ctx, body, attr_lock, frozenset())
-            return
-        if isinstance(node, ast.Attribute) \
-                and isinstance(node.value, ast.Name) \
-                and node.value.id == "self" \
-                and node.attr in attr_lock \
-                and attr_lock[node.attr] not in held:
-            yield ctx.finding(
-                self.name, node,
-                f"self.{node.attr} is declared guarded-by "
-                f"self.{attr_lock[node.attr]} but accessed outside "
-                f"`with self.{attr_lock[node.attr]}`")
-        for child in ast.iter_child_nodes(node):
-            yield from self._visit(ctx, child, attr_lock, held)
+# guarded-by moved to lock_rules.py in the v2 engine (flow-aware when
+# the project graph is available, lexical on subset runs); re-exported
+# here so ALL_RULES and existing imports keep one canonical home.
+from .kernel_rules import KernelDisciplineRule  # noqa: E402
+from .lock_rules import GuardedByRule, LockOrderRule  # noqa: E402
+from .taint import VerdictTaintRule  # noqa: E402
 
 
 class FailPointRule(Rule):
@@ -373,7 +291,7 @@ class FailPointRule(Rule):
                 self._seen[label] = (f.path, f.line)
                 self._sites.append((label, f))
 
-    def finalize(self, root: str) -> Iterator[Finding]:
+    def finalize(self, root: str, project=None) -> Iterator[Finding]:
         yield from self._dups
         doc_path = os.path.join(root, "docs", "SIMNET.md")
         try:
@@ -430,7 +348,7 @@ class MetricsDriftRule(Rule):
     roots: Tuple[str, ...] = ()
     tree_rule = True
 
-    def finalize(self, root: str) -> Iterator[Finding]:
+    def finalize(self, root: str, project=None) -> Iterator[Finding]:
         gen = os.path.join(root, "cometbft_tpu", "libs", "metrics_gen.py")
         script = os.path.join(root, "tools", "metricsgen.py")
         if not (os.path.exists(gen) and os.path.exists(script)):
@@ -454,4 +372,5 @@ class MetricsDriftRule(Rule):
 
 ALL_RULES = [WallClockRule, GlobalRngRule, RawEnvRule, ReactorSleepRule,
              GuardedByRule, FailPointRule, BareExceptRule,
-             MetricsDriftRule]
+             MetricsDriftRule, LockOrderRule, VerdictTaintRule,
+             KernelDisciplineRule]
