@@ -506,6 +506,170 @@ def _aggsig_mode() -> int:
     return 0
 
 
+def _measure_mesh_mode(n_devices: int, iters: int) -> int:
+    """Child process: build the (commit, sig) topology over
+    `n_devices`, warm the planned bucket (ledger-recorded under the
+    mesh-shape kernel key), and time sharded dispatches through the
+    real MeshExecutor. One JSON line on stdout. Isolated per device
+    count: a mesh-compile crash kills only this child and the parent
+    still emits the other counts."""
+    enable_compile_cache()
+    from collections import Counter
+    from cometbft_tpu.libs.jax_cache import ledger
+    from cometbft_tpu.mesh import MeshExecutor, MeshTopology
+    from cometbft_tpu.mesh.planner import lanes_kernel_name
+
+    from cometbft_tpu.device.health import CANARY_LANES
+    width = int(os.environ.get("BENCH_MESH_WIDTH", "512"))
+    topology = MeshTopology(n_devices=n_devices)
+    view = topology.view()
+    if view.n_shards != n_devices:
+        raise SystemExit(f"only {view.n_shards} devices available, "
+                         f"wanted {n_devices}")
+    ex = MeshExecutor(topology, threaded=False)
+    n_real = max(1, (width - CANARY_LANES) * view.n_shards)
+    kernel = lanes_kernel_name(view.shape)
+    bucket = width * view.n_shards
+    warm_before = ledger().seen(kernel, bucket)
+    _log(f"mesh[{n_devices}]: shape {view.shape[0]}x{view.shape[1]}, "
+         f"bucket {bucket} ({width}/shard), warming...")
+    t0 = time.monotonic()
+    ex.warm([width], probe=False)  # a bench child never regrows
+    compile_s = time.monotonic() - t0
+    _log(f"mesh[{n_devices}]: warm in {compile_s:.1f}s; generating "
+         f"{n_real} signatures...")
+    pubs, msgs, sigs = _gen_signatures(n_real)
+    # one untimed dispatch of the REAL batch: generic first-call
+    # warm-up (device transfer paths, host marshalling caches) so the
+    # timed loop measures steady state only
+    t0 = time.monotonic()
+    ex.verify(pubs, msgs, sigs)
+    compile_s += time.monotonic() - t0
+    t0 = time.perf_counter()
+    fut = None
+    for _ in range(iters):
+        fut = ex.submit(pubs, msgs, sigs)
+        out = fut.result()
+    dt = time.perf_counter() - t0
+    assert all(out), "bench lanes must all verify"
+    per_shard = Counter(fut.shards)
+    ex.close()
+    rec = {
+        "devices": n_devices,
+        "shape": list(view.shape),
+        "sigs_per_sec": round(n_real * iters / dt, 1),
+        "bucket": bucket,
+        "lanes_per_dispatch": n_real,
+        "compile_s": round(compile_s, 2),
+        "ledger_warm_before": warm_before,
+        # per-shard result attribution: every lane's verdict names the
+        # shard that produced it (device/protocol trailer semantics)
+        "per_shard_lanes": {str(k): v
+                            for k, v in sorted(per_shard.items())},
+    }
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+def _mesh_mode() -> int:
+    """`bench.py --mesh`: per-device-count sigs/s through the sharded
+    mesh executor (the ISSUE-12 acceptance bench). ALWAYS emits one
+    JSON line: with no reachable device the measurement falls back to
+    forced host-platform CPU devices (XLA_FLAGS
+    --xla_force_host_platform_device_count), attributed via
+    backend/fallback_reason/cpu_clamp — a wedged tunnel degrades the
+    number, never the emission.
+
+    Env knobs: BENCH_MESH_DEVICES ("1,2,4,8"), BENCH_MESH_WIDTH
+    (per-shard lanes, default 512 device / 8 CPU-clamped),
+    BENCH_ITERS, BENCH_MEASURE_TIMEOUT, BENCH_ALLOW_CPU."""
+    iters = int(os.environ.get("BENCH_ITERS", "4"))
+    counts = [int(c) for c in os.environ.get(
+        "BENCH_MESH_DEVICES", "1,2,4,8").split(",") if c.strip()]
+    allow_cpu = os.environ.get("BENCH_ALLOW_CPU") == "1"
+    measure_timeout = float(os.environ.get("BENCH_MEASURE_TIMEOUT",
+                                           "1500"))
+    platform = probe_backend()
+    fallback_reason = None
+    if platform is None:
+        fallback_reason = "device-unreachable (probe budget exhausted)"
+    elif platform == "cpu" and not allow_cpu:
+        fallback_reason = "cpu-backend-only"
+    from cometbft_tpu.libs.jax_cache import ledger
+    from cometbft_tpu.mesh.planner import lanes_kernel_name
+    from cometbft_tpu.parallel.mesh import factor_mesh_shape
+    want_width = int(os.environ.get("BENCH_MESH_WIDTH",
+                                    "512" if not fallback_reason
+                                    else "8"))
+    results = {}
+    best = 0.0
+    for d in counts:
+        child_env = dict(os.environ)
+        cpu_clamp = None
+        width = want_width
+        if fallback_reason:
+            # forced host devices stand in for the mesh; clamp the
+            # per-shard width to the smallest bucket unless the ledger
+            # shows this exact (mesh-shape, bucket) compiled cleanly
+            # on cpu before (same lift rule as the kernel bench)
+            child_env["JAX_PLATFORMS"] = "cpu"
+            child_env["XLA_FLAGS"] = (
+                child_env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={d}")
+            shape = factor_mesh_shape(d)
+            if want_width > 8 and ledger().seen(
+                    lanes_kernel_name(shape), want_width * d,
+                    platform="cpu"):
+                cpu_clamp = "lifted-ledger-warm"
+            else:
+                cpu_clamp = "clamped-width-8"
+                width = min(want_width, 8)
+        child_env["BENCH_MESH_WIDTH"] = str(width)
+        _log(f"measuring mesh over {d} device(s) in a subprocess "
+             f"(timeout {measure_timeout:.0f}s)...")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--measure-mesh", str(d), str(iters)],
+                env=child_env, capture_output=True, text=True,
+                timeout=measure_timeout)
+        except subprocess.TimeoutExpired:
+            results[str(d)] = {"error": "timeout"}
+            continue
+        sys.stderr.write(r.stderr)
+        line = next((ln for ln in r.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if r.returncode == 0 and line:
+            rec = json.loads(line)
+            if cpu_clamp:
+                rec["cpu_clamp"] = cpu_clamp
+            results[str(d)] = rec
+            best = max(best, rec["sigs_per_sec"])
+        else:
+            if r.returncode < 0:
+                ledger().record_crash(
+                    lanes_kernel_name(factor_mesh_shape(d)), width * d,
+                    f"signal {-r.returncode}",
+                    platform="cpu" if fallback_reason else None)
+            results[str(d)] = {
+                "error": f"rc={r.returncode}",
+                "detail": (r.stderr or "").strip().splitlines()[-1:]}
+    rec = {
+        "metric": "mesh_verify_throughput",
+        "value": round(best, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(best / BASELINE_SIGS_PER_SEC, 3),
+        "per_device_count": results,
+        "iters": iters,
+        "compile_cache": ledger().attribution(),
+    }
+    if fallback_reason:
+        rec["backend"] = "cpu"
+        rec["fallback_reason"] = fallback_reason
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "8192"))
     iters = int(os.environ.get("BENCH_ITERS", "4"))
@@ -649,8 +813,12 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--measure":
         sys.exit(_measure_mode(int(sys.argv[2]), int(sys.argv[3])))
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-mesh":
+        sys.exit(_measure_mesh_mode(int(sys.argv[2]), int(sys.argv[3])))
     if len(sys.argv) > 1 and sys.argv[1] == "--pipeline":
         sys.exit(_pipeline_mode())
     if len(sys.argv) > 1 and sys.argv[1] == "--aggsig":
         sys.exit(_aggsig_mode())
+    if len(sys.argv) > 1 and sys.argv[1] == "--mesh":
+        sys.exit(_mesh_mode())
     sys.exit(main())

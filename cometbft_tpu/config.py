@@ -217,6 +217,19 @@ class DeviceConfig:
     probe_backoff_base_ms: int = 500    # first half-open window
     probe_backoff_cap_ms: int = 30_000  # exponential backoff ceiling
     probe_deadline_ms: int = 2_000      # per-probe answer deadline
+    # multi-chip mesh serving (mesh/ — docs/MESH.md): own every local
+    # device as one (commit, sig) verification mesh instead of a
+    # single chip. Off by default: single-chip nodes and the CPU test
+    # platform must never pay mesh compiles.
+    mesh: bool = False
+    mesh_devices: int = 0               # 0 = all local devices
+    mesh_sig_parallel: int = 0          # 0 = auto (2 when even, else 1)
+    mesh_tiles_per_shard: int = 4       # pipeline depth multiplier
+    # per-shard quarantine re-probe backoff (shard_health.py); the
+    # node-level probe_backoff_* above governs the whole-backend
+    # supervisor, this one the per-shard regrow schedule
+    mesh_backoff_base_ms: int = 1_000
+    mesh_backoff_cap_ms: int = 60_000
 
     def validate_basic(self) -> None:
         if self.probe_backoff_base_ms <= 0:
@@ -227,6 +240,31 @@ class DeviceConfig:
                              "probe_backoff_base_ms")
         if self.probe_deadline_ms <= 0:
             raise ValueError("device.probe_deadline_ms must be positive")
+        if not 0 <= self.mesh_devices < 255:
+            # shard ids ride a u8 in the protocol attribution trailer
+            # with 0xFF reserved for the CPU re-verify sentinel
+            raise ValueError(
+                "device.mesh_devices must be in [0, 254]")
+        if self.mesh_sig_parallel < 0:
+            raise ValueError("device.mesh_sig_parallel must be >= 0")
+        if self.mesh_devices and self.mesh_sig_parallel \
+                and self.mesh_devices % self.mesh_sig_parallel:
+            # the typed factoring error surfaces at CONFIG time (the
+            # parallel/mesh.MeshShapeError contract): a node booted
+            # with an impossible mesh must fail validation, not crash
+            # later inside topology discovery
+            raise ValueError(
+                f"device.mesh_devices={self.mesh_devices} does not "
+                f"divide by mesh_sig_parallel={self.mesh_sig_parallel}")
+        if not 1 <= self.mesh_tiles_per_shard <= 64:
+            raise ValueError("device.mesh_tiles_per_shard must be in "
+                             "[1, 64]")
+        if self.mesh_backoff_base_ms <= 0:
+            raise ValueError("device.mesh_backoff_base_ms must be "
+                             "positive")
+        if self.mesh_backoff_cap_ms < self.mesh_backoff_base_ms:
+            raise ValueError("device.mesh_backoff_cap_ms must be >= "
+                             "mesh_backoff_base_ms")
 
 
 @dataclass

@@ -16,6 +16,15 @@ Response payload:
     batch_ok u8       (1 iff every lane verified)
     n        u32le
     n × u8 per-lane validity
+    [n × u8 per-lane shard attribution]   (optional trailer)
+
+The attribution trailer is how a MESH-owning server (mesh/executor.py)
+reports WHICH shard verified each lane (0xFF = the trusted CPU
+re-verify path after a shard canary failure). It is backward
+compatible by construction: v1 `decode_response` reads exactly n
+verdict bytes and ignores a trailer, so old clients keep working
+against a mesh server, and `decode_response_shards` returns None for
+a single-chip server that sends no trailer.
 
 The protocol is deliberately dumb-binary (no proto/JSON): a C caller
 can marshal it with memcpy, and the server's hot loop does one pass of
@@ -26,7 +35,7 @@ from __future__ import annotations
 
 import socket
 import struct
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 
 def send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -83,9 +92,23 @@ def decode_request(payload: bytes
     return req_id, pubs, msgs, sigs
 
 
-def encode_response(req_id: int, batch_ok: bool, oks: List[bool]) -> bytes:
-    return (struct.pack("<QBI", req_id, 1 if batch_ok else 0, len(oks))
-            + bytes(1 if v else 0 for v in oks))
+CPU_SHARD = 0xFF  # attribution sentinel: verdict from CPU re-verify
+
+
+def encode_response(req_id: int, batch_ok: bool, oks: List[bool],
+                    shards: Optional[List[int]] = None) -> bytes:
+    out = (struct.pack("<QBI", req_id, 1 if batch_ok else 0, len(oks))
+           + bytes(1 if v else 0 for v in oks))
+    if shards is not None:
+        if len(shards) != len(oks):
+            raise ValueError("shard attribution length mismatch")
+        if any(not 0 <= s <= CPU_SHARD for s in shards):
+            # a shard id past the u8 range must fail loudly: clamping
+            # would alias real shards onto the CPU_SHARD sentinel and
+            # silently corrupt the attribution this trailer exists for
+            raise ValueError("shard id out of u8 attribution range")
+        out += bytes(shards)
+    return out
 
 
 def decode_response(payload: bytes) -> Tuple[int, bool, List[bool]]:
@@ -97,3 +120,20 @@ def decode_response(payload: bytes) -> Tuple[int, bool, List[bool]]:
     if len(body) != n:
         raise ValueError("malformed verify response")
     return req_id, bool(batch_ok), [b == 1 for b in body]
+
+
+def decode_response_shards(payload: bytes) -> Optional[List[int]]:
+    """The per-lane shard attribution trailer, or None when the server
+    sent a v1 (single-chip) response. A trailer of the wrong length is
+    malformed — attribution misaligned with verdicts is worse than
+    absent."""
+    try:
+        _req_id, _batch_ok, n = struct.unpack_from("<QBI", payload, 0)
+    except struct.error as e:
+        raise ValueError(f"short response header: {e}") from e
+    tail = payload[13 + n:]
+    if not tail:
+        return None
+    if len(tail) != n:
+        raise ValueError("malformed shard attribution trailer")
+    return list(tail)

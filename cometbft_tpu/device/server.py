@@ -41,7 +41,9 @@ class _Job:
 class DeviceServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  bucket: int = 1024, max_msg_len: int = 256,
-                 flush_us: int = 200):
+                 flush_us: int = 200, mesh: bool = False,
+                 mesh_devices: int = 0, sig_parallel: int = 0,
+                 tiles_per_shard: int = 4):
         from ..libs.jax_cache import is_device_platform
         if not is_device_platform() and bucket > 64:
             # XLA:CPU crashes (compiler stack overflow) building the
@@ -52,6 +54,17 @@ class DeviceServer:
         self.bucket = bucket
         self.max_msg_len = max_msg_len
         self.flush_s = flush_us / 1e6
+        # mesh mode: the server owns EVERY local device as one
+        # (commit, sig) verification mesh (mesh/ — docs/MESH.md)
+        # instead of a single chip; responses then carry the per-lane
+        # shard attribution trailer. The (1,1) single-device case is
+        # served by the same executor (its degenerate path), so one
+        # code path covers both deployments.
+        self.mesh = mesh
+        self.mesh_devices = mesh_devices
+        self.sig_parallel = sig_parallel
+        self.tiles_per_shard = tiles_per_shard
+        self._mesh_exec = None  # mesh.MeshExecutor once warmed
         self._jobs: "queue.Queue[Optional[_Job]]" = queue.Queue()
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET,
@@ -93,9 +106,34 @@ class DeviceServer:
             verify_batch([pub], [b"warm"], [sig], batch_size=self.bucket)
         with ledger().compile_guard("ed25519-rlc-fallback", self.bucket):
             verify_batch([pub], [b"warm"], [bad], batch_size=self.bucket)
+        if self.mesh:
+            self._warm_mesh()
+
+    def _warm_mesh(self) -> None:
+        """Build + warm the mesh executor: topology over the local
+        devices, planned bucket compiles recorded in the CompileLedger
+        under mesh-shape keys (mesh compiles are minutes, not
+        milliseconds — they may NEVER land on a live flush)."""
+        from ..mesh import MeshExecutor, MeshTopology
+        from ..mesh.planner import width_ladder
+        topology = MeshTopology(
+            n_devices=self.mesh_devices or None,
+            sig_parallel=self.sig_parallel or None)
+        self._mesh_exec = MeshExecutor(
+            topology, tiles_per_shard=self.tiles_per_shard)
+        # warm the whole width LADDER for the widest flush the writer
+        # can coalesce — NOT just self.bucket: the flush loop checks
+        # `lanes < bucket` BEFORE adding the next job, and one job may
+        # itself carry bucket + CANARY_LANES lanes, so a flush can
+        # reach (bucket - 1) + bucket + CANARY_LANES lanes. Every
+        # reachable bucket must compile before traffic — a cold mesh
+        # compile inside a live flush is minutes.
+        self._mesh_exec.warm(width_ladder(
+            2 * self.bucket + CANARY_LANES,
+            topology.view().n_shards, canary=True))
+        self.stats["mesh_shards"] = topology.view().n_shards
 
     def _flush(self, jobs: List[_Job]) -> None:
-        from ..ops.ed25519 import verify_batch
         pubs: List[bytes] = []
         msgs: List[bytes] = []
         sigs: List[bytes] = []
@@ -103,14 +141,38 @@ class DeviceServer:
             pubs.extend(j.pubs)
             msgs.extend(j.msgs)
             sigs.extend(j.sigs)
-        oks = verify_batch(pubs, msgs, sigs, batch_size=self.bucket)
+        shards = None
+        if self._mesh_exec is not None:
+            # the mesh data plane: lanes sharded over every device,
+            # per-shard canaries checked inside the executor (a lying
+            # shard is masked + the batch re-verifies on CPU before
+            # any verdict reaches a client), per-lane attribution
+            # returned in the response trailer. Bounded wait + closed-
+            # executor handling: stop() can close the executor while
+            # this worker drains its final batch, and an unbounded
+            # result() would hang the flush thread forever
+            from .client import deadline_for
+            try:
+                fut = self._mesh_exec.submit(pubs, msgs, sigs)
+                oks = fut.result(deadline_for(len(pubs)))
+                shards = fut.shards
+            except (ConnectionError, TimeoutError):
+                if self._stop.is_set():
+                    return  # shutting down: clients are going away
+                raise
+        else:
+            from ..ops.ed25519 import verify_batch
+            oks = verify_batch(pubs, msgs, sigs, batch_size=self.bucket)
         self.stats["flushes"] += 1
         self.stats["signatures"] += len(pubs)
         off = 0
         for j in jobs:
             part = [bool(v) for v in oks[off:off + len(j.pubs)]]
+            job_shards = (None if shards is None
+                          else shards[off:off + len(j.pubs)])
             off += len(j.pubs)
-            resp = encode_response(j.req_id, all(part), part)
+            resp = encode_response(j.req_id, all(part), part,
+                                   shards=job_shards)
             try:
                 with j.lock:
                     send_frame(j.sock, resp)
@@ -118,7 +180,12 @@ class DeviceServer:
                 pass  # client gone; its lanes were still verified
 
     def _device_routine(self) -> None:
-        """Single device writer: accumulate jobs, flush as one tile."""
+        """Single device writer: accumulate jobs, flush as one tile.
+        A failing flush (mesh dispatch timeout, backend crash) must
+        never kill this thread — it is the server's ONLY writer, and
+        a dead writer leaves every future client hanging silently.
+        The failed batch answers UNPROCESSABLE (zero lanes) so those
+        clients fall back to local verification."""
         while not self._stop.is_set():
             try:
                 job = self._jobs.get(timeout=0.5)
@@ -131,6 +198,7 @@ class DeviceServer:
             # coalesce whatever arrives within the flush window, up to
             # the bucket capacity
             deadline = _now() + self.flush_s
+            drain = False
             while lanes < self.bucket:
                 try:
                     nxt = self._jobs.get(timeout=max(
@@ -138,11 +206,25 @@ class DeviceServer:
                 except queue.Empty:
                     break
                 if nxt is None:
-                    self._flush(batch)
-                    return
+                    drain = True
+                    break
                 batch.append(nxt)
                 lanes += len(nxt.pubs)
-            self._flush(batch)
+            try:
+                self._flush(batch)
+            except Exception as e:  # noqa: BLE001 — answer, survive
+                for j in batch:
+                    try:
+                        with j.lock:
+                            send_frame(j.sock, encode_response(
+                                j.req_id, False, []))
+                    except OSError:
+                        pass
+                print(f"device server: flush failed "
+                      f"({type(e).__name__}: {e}); batch answered "
+                      f"UNPROCESSABLE", flush=True)
+            if drain:
+                return
 
     def _unprocessable(self, pubs: List[bytes], msgs: List[bytes]
                        ) -> bool:
@@ -205,6 +287,8 @@ class DeviceServer:
     def stop(self) -> None:
         self._stop.set()
         self._jobs.put(None)
+        if self._mesh_exec is not None:
+            self._mesh_exec.close()
         try:
             self._listener.close()
         except OSError:
@@ -225,16 +309,29 @@ def main(argv=None) -> int:
     ap.add_argument("--laddr", default="127.0.0.1:28657")
     ap.add_argument("--bucket", type=int, default=1024)
     ap.add_argument("--max-msg-len", type=int, default=256)
+    ap.add_argument("--mesh", action="store_true",
+                    help="own every local device as one (commit, sig) "
+                         "verification mesh (docs/MESH.md)")
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="devices to mesh (0 = all local)")
+    ap.add_argument("--sig-parallel", type=int, default=0,
+                    help="mesh sig-axis width (0 = auto)")
+    ap.add_argument("--tiles-per-shard", type=int, default=4)
     args = ap.parse_args(argv)
     from ..libs.jax_cache import enable_compile_cache
     enable_compile_cache()
     host, _, port = args.laddr.rpartition(":")
     srv = DeviceServer(host or "127.0.0.1", int(port),
                        bucket=args.bucket,
-                       max_msg_len=args.max_msg_len)
+                       max_msg_len=args.max_msg_len,
+                       mesh=args.mesh, mesh_devices=args.mesh_devices,
+                       sig_parallel=args.sig_parallel,
+                       tiles_per_shard=args.tiles_per_shard)
     srv.start()
     import jax
-    print(f"device server on {srv.addr} device={jax.devices()[0]} "
+    what = (f"mesh={srv.stats.get('mesh_shards')}-shards" if args.mesh
+            else f"device={jax.devices()[0]}")
+    print(f"device server on {srv.addr} {what} "
           f"bucket={srv.bucket}", flush=True)
     try:
         import time

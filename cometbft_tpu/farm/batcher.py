@@ -8,8 +8,13 @@ signature once — and dispatches the unique lanes through the
 `DeviceClient.submit()` seam with the PR-3 protections intact: canary
 lanes spliced per batch, a canary mismatch quarantines the device via
 the shared supervisor, and transport failures degrade to the native
-CPU per-signature path (never the XLA kernel — a farm flush must not
-pay a multi-minute CPU jit, docs/PERF.md "known compile hazard").
+CPU per-signature path. Without a device server at all, WIDE batches
+route through the actual batch kernel when the CompileLedger proves
+the shape bucket warm (`_fallback_verify` — ROADMAP item-4 residual);
+a cold bucket keeps the per-sig native clamp, because a farm flush
+must never pay a multi-minute CPU jit (docs/PERF.md "known compile
+hazard"). The chosen backend per batch (device / kernel / cpu) lands
+in `FarmMetrics.lanes_verified{backend}`.
 
 Backpressure is explicit: `submit()` raises QueueFull once the pending
 queue holds `max_pending_lanes` — the RPC layer turns that into a
@@ -105,10 +110,53 @@ class CheckTicket:
 
 def _native_verify(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
     """CPU fallback: per-signature native verify (~50µs/sig via the C
-    fast path) — the same clamp blocksync applies on CPU nodes; never
-    the JAX kernel (compile hazard)."""
+    fast path) — the same clamp blocksync applies on CPU nodes."""
     return [lane.pk.verify_signature(lane.msg, lane.sig)
             for lane in lanes], "cpu"
+
+
+# a farm flush narrower than this stays per-sig native even when the
+# kernel is warm: dispatch + padding overhead beats ~50µs/sig only
+# once the batch is wide
+FARM_KERNEL_MIN_LANES = 128
+
+
+def _fallback_verify(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
+    """The no-device-server path, with the ROADMAP item-4 residual
+    closed: a WIDE all-ed25519 batch routes through the actual batch
+    kernel when the CompileLedger proves the bucket warm — process-
+    local warmth always (the jit cache makes the wide kernel the
+    cheaper path, same lift as crypto/keys.Ed25519BatchVerifier), or a
+    clean on-disk entry on a real device platform (the persistent
+    cache reloads the executable). A cold or compiler-fatal bucket
+    keeps the per-sig native clamp — a farm flush must never pay a
+    multi-minute XLA:CPU jit (docs/PERF.md "known compile hazard").
+    The chosen backend lands in FarmMetrics.lanes_verified{backend}
+    via the label this returns."""
+    n = len(lanes)
+    if n >= FARM_KERNEL_MIN_LANES \
+            and all(lane.pk.type_() == ED25519 for lane in lanes) \
+            and max(len(lane.msg) for lane in lanes) <= 128:
+        # the <=128 guard pins the msg-cap kernel variant: the ledger
+        # keys (kernel, bucket) without the cap dimension, and the
+        # warmed executables (prewarm, earlier flushes) are the
+        # cap-128 ones — a longer message would select a DIFFERENT
+        # never-compiled variant and pay the multi-minute jit this
+        # clamp exists to avoid
+        from ..libs.jax_cache import is_device_platform, ledger
+        eff = 1 << (n - 1).bit_length()
+        lg = ledger()
+        warm = lg.warm_in_process("ed25519-rlc", eff) or (
+            is_device_platform() and lg.seen("ed25519-rlc", eff))
+        if warm and not lg.known_crash("ed25519-rlc", eff):
+            from ..ops.ed25519 import verify_batch
+            with lg.compile_guard("ed25519-rlc", eff):
+                out = verify_batch([lane.pub for lane in lanes],
+                                   [lane.msg for lane in lanes],
+                                   [lane.sig for lane in lanes],
+                                   batch_size=eff)
+            return [bool(v) for v in out], "kernel"
+    return _native_verify(lanes)
 
 
 def device_or_cpu_backend(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
@@ -119,13 +167,13 @@ def device_or_cpu_backend(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
     from ..device import health
     from ..device.client import DeviceUnprocessable, shared_client
     if any(lane.pk.type_() != ED25519 for lane in lanes):
-        return _native_verify(lanes)  # device server is ed25519-only
+        return _native_verify(lanes)  # kernels are ed25519-only
     client = shared_client()
     if client is None:
-        return _native_verify(lanes)
+        return _fallback_verify(lanes)
     sup = health.shared_supervisor()
     if not sup.allow_connect():
-        return _native_verify(lanes)
+        return _fallback_verify(lanes)
     pubs = [lane.pub for lane in lanes]
     msgs = [lane.msg for lane in lanes]
     sigs = [lane.sig for lane in lanes]

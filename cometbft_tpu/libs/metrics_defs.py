@@ -81,8 +81,9 @@ METRICS_SPEC = {
         ("gauge", "batch_width", "farm_batch_width",
          "Unique-lane width of the most recent coalesced batch", ()),
         ("counter", "lanes", "farm_lanes_verified",
-         "Signature lanes verified, by backend (device vs cpu)",
-         ("backend",)),
+         "Signature lanes verified, by backend (device = server seam, "
+         "kernel = ledger-warm local batch kernel, cpu = per-sig "
+         "native)", ("backend",)),
         ("counter", "dedup_hits", "farm_dedup_hits",
          "Lanes skipped by dedup (batch=intra-batch; SigCache hits "
          "show under pipeline_sigcache_hits path=farm)", ("kind",)),
@@ -140,6 +141,34 @@ METRICS_SPEC = {
          "Kernel batches whose known-answer final-exp canaries "
          "answered wrong (kernel quarantined, batch re-run on CPU)",
          ()),
+    ],
+    # mesh/ — multi-chip sharded verification (topology.py,
+    # planner.py, executor.py, shard_health.py): the serving device
+    # mesh, its degrade/regrow arc, and per-shard verdict safety
+    "MeshMetrics": [
+        ("gauge", "shards_total", "mesh_shards_total",
+         "Devices discovered into the verification mesh", ()),
+        ("gauge", "shards_healthy", "mesh_shards_healthy",
+         "Shards currently serving (total minus masked)", ()),
+        ("counter", "refactors", "mesh_refactors_total",
+         "Topology re-factorings (shard masked out or regrown)", ()),
+        ("counter", "shard_quarantines", "mesh_shard_quarantines_total",
+         "Shards masked out for wrong canary/pad verdicts", ()),
+        ("counter", "shard_regrows", "mesh_shard_regrows_total",
+         "Masked shards readmitted after a correct known-answer probe",
+         ()),
+        ("counter", "shard_probes", "mesh_shard_probes_total",
+         "Known-answer regrow probes sent to masked shards", ()),
+        ("counter", "shard_canary_failures",
+         "mesh_shard_canary_failures",
+         "Per-shard canary/pad rows that answered wrong (dispatch or "
+         "probe)", ()),
+        ("counter", "tiles", "mesh_tiles_dispatched",
+         "Batches dispatched through the mesh executor", ()),
+        ("counter", "lanes", "mesh_lanes_verified",
+         "Signature lanes verified, by backend (mesh; cpu = the "
+         "canary-failure re-verify or the cold-shape fallback while a "
+         "re-factored mesh compiles in the background)", ("backend",)),
     ],
     # reference mempool/metrics.go
     "MempoolMetrics": [

@@ -1,0 +1,221 @@
+"""Shard planner: pad-and-mask batches onto ledger-warm shape buckets.
+
+Mesh executables are the most expensive compiles in the tree (the r05
+8-device dry-run paid 2m22s for one shape — MULTICHIP_r05.json), so
+shapes are never improvised on the hot path: every dispatch is padded
+to a per-shard power-of-two width bucket, the compile for each
+(kernel, bucket, mesh-shape) is PLANNED (executor.warm at boot /
+bench setup) and recorded in `libs/jax_cache.CompileLedger`, and the
+hot path only ever re-enters shapes the process already compiled.
+The mesh shape rides the ledger's kernel field ("mesh-lanes@4x2"),
+which composes with the ledger's existing platform|jax-version
+keying — a 4x2 compile can never vouch for a 2x2 one, nor a CPU
+compile for a TPU one.
+
+Lane layout (the flat per-lane path the device server / pipeline
+dispatch): each shard owns a contiguous `shard_width` slice of the
+padded batch — exactly the chunks `PartitionSpec` deals a flat array
+over the mesh's devices — ordered [real lanes | padding | canary
+good | canary bad]. Padding replicates the known-GOOD canary triple,
+so every non-real slot has a KNOWN expected verdict and the per-shard
+canary check covers pad rows too: a shard that flips any non-real
+verdict is caught even when its real lanes happen to agree.
+
+Grid layout (the (commits, validators) tally path): commit and
+validator axes pad up to multiples of the mesh shape with zero-power
+lanes, so the exact int64 power-plane tally (split/combine in
+parallel/verify.py) is unchanged by padding — absent lanes contribute
+exactly 0 to every 16-bit plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..device.health import CANARY_LANES, canary_pair
+from ..parallel.verify import (combine_power_planes, split_power_planes)
+
+MIN_SHARD_WIDTH = 8
+MAX_SHARD_WIDTH = 1 << 20
+
+
+def lanes_kernel_name(shape: Tuple[int, int]) -> str:
+    """CompileLedger kernel id for the per-lane sharded verifier on a
+    (commit, sig) mesh shape."""
+    return f"mesh-lanes@{shape[0]}x{shape[1]}"
+
+
+def grid_kernel_name(shape: Tuple[int, int]) -> str:
+    return f"mesh-grid@{shape[0]}x{shape[1]}"
+
+
+def rlc_kernel_name(shape: Tuple[int, int]) -> str:
+    return f"mesh-rlc@{shape[0]}x{shape[1]}"
+
+
+def shard_width_for(n_real: int, n_shards: int, canary: bool) -> int:
+    """Per-shard bucket width: next power of two that fits this
+    shard's share of the real lanes plus its canary pair, floored at
+    MIN_SHARD_WIDTH (tiny batches share one warm small bucket instead
+    of minting a fresh compile per width)."""
+    if n_shards <= 0:
+        raise ValueError(f"n_shards must be positive, got {n_shards}")
+    need = -(-max(0, n_real) // n_shards) \
+        + (CANARY_LANES if canary else 0)
+    width = MIN_SHARD_WIDTH
+    while width < need:
+        width <<= 1
+        if width > MAX_SHARD_WIDTH:
+            raise ValueError(f"batch of {n_real} lanes over {n_shards} "
+                             f"shards exceeds the bucket cap")
+    return width
+
+
+@dataclass(frozen=True)
+class LanePlan:
+    """One planned flat-lane dispatch: n_real lanes over n_shards
+    contiguous slices of shard_width rows each."""
+
+    n_real: int
+    n_shards: int
+    shard_width: int
+    canary: bool
+
+    @property
+    def bucket(self) -> int:
+        return self.n_shards * self.shard_width
+
+    @property
+    def real_per_shard(self) -> int:
+        return self.shard_width - (CANARY_LANES if self.canary else 0)
+
+    def row_of(self, lane: int) -> int:
+        """Padded-batch row of real lane `lane`."""
+        cap = self.real_per_shard
+        return (lane // cap) * self.shard_width + lane % cap
+
+    def shard_of(self, lane: int) -> int:
+        """Shard INDEX (position in the serving view, not global shard
+        id) a real lane lands on — the per-shard attribution the
+        device protocol reports back per verdict."""
+        return lane // self.real_per_shard
+
+    def build(self, pubs: Sequence[bytes], msgs: Sequence[bytes],
+              sigs: Sequence[bytes]
+              ) -> Tuple[List[bytes], List[bytes], List[bytes]]:
+        """Padded lane lists of exactly `bucket` rows. Non-real rows
+        are the known-good canary triple except each shard's final row
+        (known-bad) when canaries are on."""
+        good, bad = canary_pair()
+        out_p = [good[0]] * self.bucket
+        out_m = [good[1]] * self.bucket
+        out_s = [good[2]] * self.bucket
+        for lane in range(self.n_real):
+            r = self.row_of(lane)
+            out_p[r], out_m[r], out_s[r] = (pubs[lane], msgs[lane],
+                                            sigs[lane])
+        if self.canary:
+            for s in range(self.n_shards):
+                r = s * self.shard_width + self.shard_width - 1
+                out_p[r], out_m[r], out_s[r] = bad
+        return out_p, out_m, out_s
+
+    def extract(self, oks: Sequence) -> Tuple[List[bool], List[int]]:
+        """(real-lane verdicts, shard indexes whose canary/pad rows
+        answered wrong). A short or long answer marks EVERY shard bad
+        (the verdict<->lane mapping itself is untrustworthy)."""
+        verdicts = [bool(v) for v in oks]
+        if len(verdicts) != self.bucket:
+            return [], list(range(self.n_shards))
+        bad_shards: List[int] = []
+        real = [verdicts[self.row_of(i)] for i in range(self.n_real)]
+        for s in range(self.n_shards):
+            base = s * self.shard_width
+            lo = min(self.n_real - s * self.real_per_shard,
+                     self.real_per_shard)
+            lo = max(0, lo)  # shards past the last real lane
+            tail = verdicts[base + lo:base + self.shard_width]
+            want = [True] * (self.shard_width - lo)
+            if self.canary:
+                want[-1] = False
+            if tail != want:
+                bad_shards.append(s)
+        return real, bad_shards
+
+
+def plan_lanes(n_real: int, n_shards: int, canary: bool = True
+               ) -> LanePlan:
+    return LanePlan(n_real=n_real, n_shards=n_shards, canary=canary,
+                    shard_width=shard_width_for(n_real, n_shards,
+                                                canary))
+
+
+def width_ladder(max_lanes: int, n_shards: int,
+                 canary: bool = True) -> List[int]:
+    """Every shard-width bucket a batch of up to `max_lanes` lanes can
+    plan onto: [MIN_SHARD_WIDTH, ..., shard_width_for(max_lanes)].
+    Warming exactly this ladder guarantees NO flush up to max_lanes
+    ever compiles on the hot path (device/server._warm_mesh, node-boot
+    executor warm)."""
+    top = shard_width_for(max_lanes, n_shards, canary)
+    out = []
+    w = MIN_SHARD_WIDTH
+    while w <= top:
+        out.append(w)
+        w <<= 1
+    return out or [top]
+
+
+# --- the (commits, validators) grid path --------------------------------------
+
+@dataclass(frozen=True)
+class GridPlan:
+    """One planned (C, V) grid dispatch over a (commit, sig) mesh
+    shape: axes padded up to mesh-shape multiples with zero-power
+    lanes, tally exact int64 via the 16-bit power planes."""
+
+    n_commits: int
+    n_validators: int
+    shape: Tuple[int, int]
+
+    @property
+    def padded_commits(self) -> int:
+        c = self.shape[0]
+        return -(-max(1, self.n_commits) // c) * c
+
+    @property
+    def padded_validators(self) -> int:
+        v = self.shape[1]
+        return -(-max(1, self.n_validators) // v) * v
+
+    def pad_grid(self, arr: np.ndarray, fill=0) -> np.ndarray:
+        """(C, V, ...) -> (C', V', ...), new cells `fill`."""
+        C, V = self.n_commits, self.n_validators
+        Cp, Vp = self.padded_commits, self.padded_validators
+        if (C, V) == (Cp, Vp):
+            return arr
+        out = np.full((Cp, Vp) + arr.shape[2:], fill, dtype=arr.dtype)
+        out[:C, :V] = arr
+        return out
+
+    def power_planes(self, power: np.ndarray) -> np.ndarray:
+        """(C, V) int64 powers -> (C', V', 4) int32 planes; padded
+        lanes carry power 0 so they tally as exactly nothing."""
+        return self.pad_grid(split_power_planes(power))
+
+    def tally(self, plane_sums: np.ndarray) -> np.ndarray:
+        """(C', 4) device plane sums -> (C,) exact int64 totals."""
+        return combine_power_planes(
+            np.asarray(plane_sums)[:self.n_commits])
+
+    def unpad_ok(self, ok: np.ndarray) -> np.ndarray:
+        return np.asarray(ok)[:self.n_commits, :self.n_validators]
+
+
+def plan_grid(n_commits: int, n_validators: int,
+              shape: Tuple[int, int]) -> GridPlan:
+    return GridPlan(n_commits=n_commits, n_validators=n_validators,
+                    shape=shape)

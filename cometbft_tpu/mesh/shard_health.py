@@ -1,0 +1,225 @@
+"""Per-shard health: canary-verified quarantine with regrow.
+
+This extends the PR-3 device supervisor (device/health.py) one level
+down. The node-level state machine answers "may this PROCESS trust its
+verification backend at all" — and its QUARANTINED is terminal,
+because a backend that lied once and stays in the serving path could
+lie again undetectably. A mesh changes the calculus: a sick chip can
+be REMOVED from the serving topology (topology.mask -> smaller mesh)
+while the healthy shards keep serving, and every future batch still
+carries per-shard canary/pad rows (mesh/planner.py lane layout), so a
+readmitted shard is re-verified on every single dispatch. That is why
+per-shard quarantine is a mask with probed regrow, not a one-way door:
+
+    serving ──canary/pad row wrong──► MASKED (mesh re-factors smaller)
+    MASKED ──backoff elapsed──► probe (known-answer pair on that chip)
+    probe correct ──► serving again (mesh re-factors back up)
+    probe wrong/error ──► MASKED (backoff deepens, jittered exponential)
+
+Masking the LAST healthy shard is refused by topology; the supervisor
+then escalates to the node-level DeviceSupervisor's report_corruption
+— with zero trustworthy shards the process-level terminal quarantine
+is exactly right.
+
+Time flows through `libs/timesource.monotonic` and jitter through a
+fixed-seed PRNG, so the `mesh-degrade` simnet scenario replays
+byte-identically per seed.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..device.health import canary_pair
+from ..libs import timesource
+from ..libs.env import env_float
+from .topology import MeshShapeError, MeshTopology
+
+ENV_SHARD_BACKOFF_BASE = "COMETBFT_TPU_MESH_BACKOFF_BASE"  # seconds
+ENV_SHARD_BACKOFF_CAP = "COMETBFT_TPU_MESH_BACKOFF_CAP"    # seconds
+DEFAULT_SHARD_BACKOFF_BASE_S = 1.0
+DEFAULT_SHARD_BACKOFF_CAP_S = 60.0
+JITTER_FRACTION = 0.25
+
+
+class ShardSupervisor:
+    """Owns shard mask decisions over one MeshTopology. Thread-safe:
+    the executor's dispatch thread reports corruption and runs probes;
+    metrics/status readers snapshot concurrently."""
+
+    # guarded-by: _lock: _strikes, _next_probe_at, _probing
+    def __init__(self, topology: MeshTopology,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_cap_s: Optional[float] = None,
+                 metrics=None, log=None,
+                 clock: Callable[[], float] = timesource.monotonic,
+                 jitter_seed: int = 0x5A4D):
+        if backoff_base_s is None:
+            backoff_base_s = env_float(ENV_SHARD_BACKOFF_BASE,
+                                       DEFAULT_SHARD_BACKOFF_BASE_S)
+        if backoff_cap_s is None:
+            backoff_cap_s = env_float(ENV_SHARD_BACKOFF_CAP,
+                                      DEFAULT_SHARD_BACKOFF_CAP_S)
+        self.topology = topology
+        self.backoff_base_s = max(1e-6, backoff_base_s)
+        self.backoff_cap_s = max(self.backoff_base_s, backoff_cap_s)
+        self.metrics = metrics  # libs/metrics_gen.MeshMetrics or None
+        self.log = log
+        self._clock = clock
+        self._rng = random.Random(jitter_seed)
+        self._lock = threading.Lock()
+        self._strikes: Dict[int, int] = {}       # shard -> consecutive
+        self._next_probe_at: Dict[int, float] = {}
+        self._probing: set = set()
+        # monotonic counters (mesh status surfaces them)
+        self.quarantines = 0
+        self.regrows = 0
+        self.probes = 0
+        self.canary_failures = 0
+        self._emit_gauges()
+
+    # --- reports ----------------------------------------------------------
+
+    def report_shard_corruption(self, shard_id: int,
+                                detail: str = "") -> bool:
+        """A shard's canary/pad rows answered wrong: mask it out and
+        re-factor the mesh smaller. Returns True when the shard was
+        masked; False when it was the last one — the caller's batch
+        already re-verifies on CPU either way, and the node-level
+        supervisor takes over (terminal quarantine)."""
+        with self._lock:
+            self.canary_failures += 1
+            strikes = self._strikes.get(shard_id, 0) + 1
+            self._strikes[shard_id] = strikes
+            window = self._window_s(strikes)
+            self._next_probe_at[shard_id] = self._clock() + window
+        try:
+            view = self.topology.mask(shard_id)
+        except MeshShapeError:
+            from ..device import health
+            health.shared_supervisor().report_corruption(
+                f"last mesh shard {shard_id} corrupt ({detail})")
+            self._say(f"shard {shard_id} corrupt and LAST — node-level "
+                      f"quarantine ({detail})")
+            return False
+        with self._lock:
+            self.quarantines += 1
+            if self.metrics is not None:
+                self.metrics.shard_canary_failures.inc()
+                self.metrics.shard_quarantines.inc()
+                self.metrics.refactors.inc()
+        self._emit_gauges()
+        self._say(f"shard {shard_id} QUARANTINED ({detail}); mesh "
+                  f"re-factored to {view.shape[0]}x{view.shape[1]} "
+                  f"over {view.n_shards} shards; re-probe in "
+                  f"{window:.3f}s")
+        return True
+
+    # --- probed regrow ----------------------------------------------------
+
+    def probe_due(self) -> List[int]:
+        """Masked shards whose backoff window elapsed, ready for one
+        known-answer probe each. Claiming is one-shot per window: the
+        due shard's window advances as if the probe fails, so
+        concurrent dispatch threads cannot stampede one sick chip."""
+        now = self._clock()
+        due: List[int] = []
+        masked = set(self.topology.masked())
+        with self._lock:
+            for shard in sorted(masked):
+                if shard in self._probing:
+                    continue
+                if now >= self._next_probe_at.get(shard, 0.0):
+                    strikes = self._strikes.get(shard, 0) + 1
+                    self._next_probe_at[shard] = \
+                        now + self._window_s(strikes)
+                    self._probing.add(shard)
+                    due.append(shard)
+        return due
+
+    def probe(self, shard_id: int,
+              verify_fn: Callable[[List[bytes], List[bytes],
+                                   List[bytes]], Sequence]) -> bool:
+        """One known-answer pair against the MASKED chip itself (the
+        executor adapts `verify_fn` to a single-device dispatch on
+        that shard's device). Correct verdicts unmask the shard — the
+        mesh re-factors back up; wrong verdicts or transport errors
+        deepen the backoff. Returns True iff the shard rejoined."""
+        good, bad = canary_pair()
+        with self._lock:
+            self.probes += 1
+            if self.metrics is not None:
+                self.metrics.shard_probes.inc()
+        try:
+            out = verify_fn([good[0], bad[0]], [good[1], bad[1]],
+                            [good[2], bad[2]])
+            verdicts = [bool(v) for v in out]
+        except Exception as e:  # noqa: BLE001 — unreachable chip:
+            # not provably lying, but not servable either; keep masked
+            self._probe_done(shard_id)
+            self._say(f"shard {shard_id} probe error "
+                      f"({type(e).__name__}: {e}); stays masked")
+            return False
+        if verdicts != [True, False]:
+            with self._lock:
+                self.canary_failures += 1
+                if self.metrics is not None:
+                    self.metrics.shard_canary_failures.inc()
+            self._probe_done(shard_id)
+            self._say(f"shard {shard_id} probe verdicts {verdicts} != "
+                      f"[True, False]; stays masked")
+            return False
+        view = self.topology.unmask(shard_id)
+        with self._lock:
+            self._strikes.pop(shard_id, None)
+            self._next_probe_at.pop(shard_id, None)
+            self._probing.discard(shard_id)
+            self.regrows += 1
+            if self.metrics is not None:
+                self.metrics.shard_regrows.inc()
+                self.metrics.refactors.inc()
+        self._emit_gauges()
+        self._say(f"shard {shard_id} probe correct; mesh re-grown to "
+                  f"{view.shape[0]}x{view.shape[1]} over "
+                  f"{view.n_shards} shards")
+        return True
+
+    def _probe_done(self, shard_id: int) -> None:
+        with self._lock:
+            self._strikes[shard_id] = self._strikes.get(shard_id, 0) + 1
+            self._probing.discard(shard_id)
+
+    # --- internals --------------------------------------------------------
+
+    def _window_s(self, n: int) -> float:
+        """Jittered exponential backoff after the n-th consecutive
+        failure (caller holds the lock; n starts at 1)."""
+        window = min(self.backoff_cap_s,
+                     self.backoff_base_s * (2.0 ** max(0, n - 1)))
+        return window * (1.0 + JITTER_FRACTION * self._rng.random())
+
+    def _emit_gauges(self) -> None:
+        if self.metrics is not None:
+            view = self.topology.view()
+            self.metrics.shards_healthy.set(view.n_shards)
+            self.metrics.shards_total.set(self.topology.n_devices)
+
+    def _say(self, msg: str) -> None:
+        if self.log is not None:
+            self.log(f"mesh supervisor: {msg}")
+
+    def status(self) -> dict:
+        view = self.topology.view()
+        with self._lock:
+            return {
+                "shape": list(view.shape),
+                "shards_healthy": view.n_shards,
+                "shards_total": self.topology.n_devices,
+                "masked": list(self.topology.masked()),
+                "quarantines": self.quarantines,
+                "regrows": self.regrows,
+                "probes": self.probes,
+                "canary_failures": self.canary_failures,
+            }

@@ -235,6 +235,14 @@ class Node:
         from ..device.health import shared_supervisor
         shared_supervisor().configure(config.device,
                                       metrics=self.device_metrics)
+        # multi-chip mesh serving ([device] mesh — docs/MESH.md): latch
+        # the config so mesh.shared_executor() can build the process
+        # topology lazily (first node wins, same posture as the device
+        # supervisor); MeshMetrics rides the same registry
+        from .. import mesh as _mesh
+        from ..libs.metrics_gen import MeshMetrics
+        self.mesh_metrics = MeshMetrics(self.metrics_registry)
+        _mesh.configure(config.device)
         # the process-wide verified-signature cache (vote intake, light
         # client, blocksync) reports hit/miss/eviction through the same
         # struct. First node wins: with several nodes in one process
@@ -566,6 +574,18 @@ class Node:
                     from ..pipeline.scheduler import DeviceClientBackend
                     supervisor = shared_supervisor()
                     backend = DeviceClientBackend(client)
+                else:
+                    # no TPU-owner server: with [device] mesh on, this
+                    # process owns the local devices directly as one
+                    # sharded mesh (mesh/executor). The scheduler then
+                    # sizes its queue from the shard count (K tiles in
+                    # flight PER shard). No node-level supervisor:
+                    # verdict gating is the executor's own per-shard
+                    # canaries (a lying shard masks + re-factors, and
+                    # its batch re-verifies on CPU internally).
+                    from .. import mesh as _mesh
+                    backend = _mesh.shared_executor(
+                        metrics=self.mesh_metrics)
                 watchdog = DeviceWatchdog(
                     metrics=self.pipeline_metrics,
                     supervisor=supervisor)

@@ -24,20 +24,52 @@ COMMIT_AXIS = "commit"
 SIG_AXIS = "sig"
 
 
-def make_mesh(n_devices: int | None = None,
-              sig_parallel: int | None = None) -> Mesh:
-    """Factor `n_devices` into a (commit, sig) mesh.
+class MeshShapeError(ValueError):
+    """A device count / sig_parallel combination that cannot factor
+    into a (commit, sig) mesh. A typed config error, not an assert:
+    asserts vanish under `python -O`, and node boot ([device] mesh
+    section) must surface a configuration problem as a ValueError the
+    config validator and boot path can report — never an
+    AssertionError that optimized runs silently skip."""
 
-    sig_parallel defaults to 2 when even (intra-commit sharding exercises
-    the psum path) and 1 otherwise; commit-parallel takes the rest.
-    """
-    devs = jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
-    n = len(devs)
+
+def factor_mesh_shape(n: int, sig_parallel: int | None = None
+                      ) -> tuple[int, int]:
+    """Factor `n` devices into a (commit, sig) shape.
+
+    sig_parallel defaults to 2 when even (intra-commit sharding
+    exercises the psum path) and 1 otherwise; commit-parallel takes
+    the rest. Pure host math — mesh/topology.py re-factors degraded
+    sub-meshes through this same function so every factoring (8, 6,
+    4, 1, ...) is decided by one rule."""
+    if n <= 0:
+        raise MeshShapeError(f"need at least one device, got {n}")
     if sig_parallel is None:
         sig_parallel = 2 if n % 2 == 0 and n > 1 else 1
-    assert n % sig_parallel == 0, (n, sig_parallel)
+    if sig_parallel <= 0:
+        raise MeshShapeError(f"sig_parallel must be positive, "
+                             f"got {sig_parallel}")
+    if n % sig_parallel:
+        raise MeshShapeError(
+            f"{n} devices do not divide into sig_parallel="
+            f"{sig_parallel} (commit axis would be fractional)")
+    return n // sig_parallel, sig_parallel
+
+
+def make_mesh(n_devices: int | None = None,
+              sig_parallel: int | None = None,
+              devices=None) -> Mesh:
+    """Factor `n_devices` into a (commit, sig) mesh; raises
+    MeshShapeError (a ValueError) when the factoring is impossible.
+
+    `devices` overrides the jax.devices() discovery with an explicit
+    device list — mesh/topology.py builds degraded sub-meshes from
+    its unmasked-device subset through this parameter.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    commit_par, sig_parallel = factor_mesh_shape(len(devs), sig_parallel)
     import numpy as np
-    grid = np.array(devs).reshape(n // sig_parallel, sig_parallel)
+    grid = np.array(devs).reshape(commit_par, sig_parallel)
     return Mesh(grid, (COMMIT_AXIS, SIG_AXIS))

@@ -314,11 +314,26 @@ class PipelinedBlocksync:
                  backend=None, watchdog=None, metrics=None,
                  supervisor=None):
         self.r = reactor
-        self.depth = max(1, depth)
         self._own_backend = backend is None
         self.backend = backend or LocalAsyncBackend(
             lambda p, m, s: verify_lanes(
                 p, m, s, reactor.verifier.batch_size))
+        # the bounded queue sizes from the backend's SHARD count: a
+        # mesh backend (mesh/executor.MeshExecutor exposes n_shards)
+        # needs K tiles in flight PER SHARD for the PR-2 pipeline win
+        # and N-chip sharding to compose — depth alone would leave
+        # N-1 shards idle between tiles. Single-chip backends report
+        # (or default to) 1 shard and keep the old semantics exactly.
+        # Clamped to the backend's bounded dispatch queue: a deep
+        # pipeline_depth config must shrink here, not overflow the
+        # executor into MeshOverloaded trips the watchdog would latch
+        # as a wedge.
+        shards = max(1, int(getattr(self.backend, "n_shards", 1)))
+        depth = max(1, depth) * shards
+        cap = getattr(self.backend, "queue_capacity", None)
+        if isinstance(cap, int) and cap > 0:
+            depth = min(depth, cap)
+        self.depth = depth
         self.watchdog = watchdog
         self.metrics = metrics
         self.supervisor = supervisor  # device/health.DeviceSupervisor

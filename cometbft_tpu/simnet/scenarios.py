@@ -24,6 +24,7 @@ from .clock import MS
 from .flash_crowd import run_flash_crowd as _run_flash_crowd
 from .harness import Scenario, Simulation
 from .light_farm import run_light_farm as _run_light_farm
+from .mesh_degrade import run_mesh_degrade as _run_mesh_degrade
 from .transport import LinkPolicy
 
 
@@ -243,6 +244,13 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
              "forged-bitmap / undercount chains",
              target_height=3, deadline_ms=120_000, quick_target=2,
              runner=_run_bls_valset),
+    Scenario("mesh-degrade", "one mesh shard answers corrupt canary "
+             "verdicts: the shard is quarantined, the mesh re-factors "
+             "smaller, a real blocksync completes with zero corrupt "
+             "verdicts reaching apply, and the backoff-scheduled "
+             "re-probe grows the shard back",
+             target_height=24, deadline_ms=0,
+             runner=_run_mesh_degrade),
     Scenario("flash-crowd", "thousands of seeded virtual clients burst "
              "signed txs at the batched admission pipeline; the bounded "
              "queue sheds, the duplicate filter hits, tampered "

@@ -19,9 +19,19 @@ def _force_cpu_mesh(n=8):
     # The ambient env pins JAX_PLATFORMS to the real-TPU tunnel and env
     # vars are latched before we run, so the override must go through
     # jax.config BEFORE any device access (see tests/conftest.py).
+    # XLA_FLAGS is the exception: XLA parses it at BACKEND INIT, not
+    # jax import, so setting it here still works — and it is the only
+    # mechanism this jaxlib has (jax_num_cpu_devices landed in a later
+    # jax; try it second for forward compatibility).
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}")
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # pre-0.5 jax: XLA_FLAGS above decides
+        pass
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from cometbft_tpu.libs.jax_cache import enable_compile_cache
@@ -172,9 +182,157 @@ def run_graft():
     g.dryrun_multichip(8)
 
 
+def run_equiv():
+    """Sharded-vs-single-chip verdict equivalence (ISSUE 12
+    acceptance): commit lanes marshaled from clean / tampered /
+    valset-change chains verify IDENTICALLY through (a) the
+    single-chip ops.ed25519 batch kernel and (b) the mesh executor
+    over the 8-device mesh — per-lane verdicts, per-commit verdicts,
+    and tallies. Then a real PipelinedBlocksync catch-up runs with
+    the MeshExecutor as its verify backend (depth sized from the
+    shard count) — the production wiring, not a kernel demo."""
+    _force_cpu_mesh(8)
+    import numpy as np
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.engine.blocksync import (TileEntry, marshal_commit,
+                                               settle_tile)
+    from cometbft_tpu.engine.chain_gen import generate_chain
+    from cometbft_tpu.mesh import MeshExecutor, MeshTopology
+    from cometbft_tpu.ops.ed25519 import verify_batch
+
+    new_key = Ed25519PrivKey(b"\x99" * 32)
+    val_tx = b"val:" + new_key.pub_key().bytes_().hex().encode() + b"!15"
+    chains = {
+        "clean": generate_chain(6, 4, seed=3, txs_per_block=1),
+        "valset-change": generate_chain(
+            6, 4, seed=5, txs_per_block=1,
+            val_tx_heights={3: val_tx}, extra_keys=[new_key]),
+    }
+    ex = MeshExecutor(MeshTopology(), threaded=False)
+    assert ex.n_shards == 8
+    # warm the (4,2) bucket first: the executor's cold-shape gate
+    # routes never-compiled shapes to the CPU fallback, and this
+    # harness exists to exercise the MESH kernels
+    ex.warm(probe=False)
+
+    def marshal(chain, tamper=False):
+        pubs, msgs, sigs = [], [], []
+        entries = [TileEntry(height=h, block=chain.blocks[h - 1],
+                             block_id=chain.block_ids[h - 1],
+                             valset=chain.valsets[h - 1],
+                             commit=chain.seen_commits[h - 1])
+                   for h in range(1, len(chain.blocks) + 1)]
+        metas = [marshal_commit(chain.chain_id, e, pubs, msgs, sigs)
+                 for e in entries]
+        if tamper:  # flip a signature bit in every third lane
+            for i in range(0, len(sigs), 3):
+                sigs[i] = bytes([sigs[i][0] ^ 1]) + sigs[i][1:]
+        return entries, metas, pubs, msgs, sigs
+
+    for name, chain in chains.items():
+        for tamper in (False, True):
+            entries, metas, pubs, msgs, sigs = marshal(chain, tamper)
+            assert pubs, "no lanes marshaled"
+            single = [bool(v) for v in verify_batch(
+                pubs, msgs, sigs, batch_size=64)]
+            fut = ex.submit(pubs, msgs, sigs)
+            mesh = fut.result(600)
+            from cometbft_tpu.mesh.executor import CPU_SHARD
+            assert CPU_SHARD not in fut.shards, \
+                "mesh dispatch fell back to CPU (shape not warm?)"
+            assert mesh == single, (name, tamper)
+            # per-commit verdicts settle identically from either path
+            settle_tile(metas, np.array(single), pubs, msgs, sigs)
+            want_ok = [e.commit_ok for e in entries]
+            _entries2, metas2, p2, m2, s2 = marshal(chain, tamper)
+            settle_tile(metas2, np.array(mesh), p2, m2, s2)
+            got_ok = [e.commit_ok for e, _r, _n in metas2]
+            assert got_ok == want_ok == ([True] * len(want_ok)
+                                         if not tamper
+                                         else [False] * len(want_ok)), \
+                (name, tamper, got_ok, want_ok)
+
+    # the production wiring: blocksync catch-up with the mesh executor
+    # as the pipeline's verify backend (queue sized per shard)
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.db.kv import MemDB
+    from cometbft_tpu.engine.blocksync import BlocksyncReactor
+    from cometbft_tpu.engine.chain_gen import LocalChainSource
+    from cometbft_tpu.pipeline.scheduler import PipelinedBlocksync
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State, StateStore
+    from cometbft_tpu.store.blockstore import BlockStore
+
+    chain = chains["clean"]
+    app = KVStoreApplication()
+    app.init_chain(chain.chain_id, 1, [], b"")
+    db = MemDB()
+    store = BlockStore(db)
+    executor = BlockExecutor(app, state_store=StateStore(db),
+                             block_store=store)
+    state = State.from_genesis(chain.genesis)
+    reactor = BlocksyncReactor(
+        executor, store, LocalChainSource(chain), chain.chain_id,
+        tile_size=2, batch_size=0)
+    pipe = PipelinedBlocksync(reactor, depth=1, backend=ex)
+    assert pipe.depth == 8  # 1 per shard x 8 shards
+    state = pipe.run(state, 6)
+    pipe.close()
+    assert state.last_block_height == 6
+    ex.close()
+
+
+def run_refactor():
+    """Mesh-refactor matrix with the REAL sharded grid kernel: the
+    same (commits, validators) batch with Cosmos-scale powers and two
+    tampered lanes verifies on 8 -> 6 -> 4 -> 1-device factorings via
+    topology masking, and the int64 power tally is bit-exact across
+    every factoring (padding included — the 6-device (3,2) shape pads
+    the commit axis)."""
+    _force_cpu_mesh(8)
+    import numpy as np
+    from cometbft_tpu.mesh import MeshTopology, plan_grid
+    from cometbft_tpu.ops.ed25519 import prepare_batch
+    from cometbft_tpu.parallel.verify import make_sharded_verifier
+
+    C, V = 4, 4
+    pubs, msgs, sigs = _batch(C * V)
+    sigs[1 * V + 2] = bytes(64)
+    sigs[3 * V + 0] = sigs[3 * V + 0][:63] \
+        + bytes([sigs[3 * V + 0][63] ^ 1])
+    pub, sig, hb, hn, _ = prepare_batch(pubs, msgs, sigs, C * V, 64)
+    grid = lambda x: x.reshape(C, V, *x.shape[1:])
+    power = (10_000_000_000_000
+             + np.arange(1, C * V + 1, dtype=np.int64).reshape(C, V))
+    want_ok = np.ones((C, V), dtype=bool)
+    want_ok[1, 2] = False
+    want_ok[3, 0] = False
+    want_tally = np.where(want_ok, power, 0).sum(axis=1)
+
+    topo = MeshTopology()
+    for n_target, to_mask in ((8, ()), (6, (3, 5)), (4, (1, 7)),
+                              (1, (2, 4, 6))):
+        for s in to_mask:
+            topo.mask(s)
+        view = topo.view()
+        assert view.n_shards == n_target, (n_target, view)
+        gp = plan_grid(C, V, view.shape)
+        run = make_sharded_verifier(view.jax_mesh())
+        ok, planes = run(gp.pad_grid(grid(pub)), gp.pad_grid(grid(sig)),
+                         gp.pad_grid(grid(hb)),
+                         gp.pad_grid(grid(hn), fill=1),
+                         gp.power_planes(power))
+        ok = gp.unpad_ok(np.asarray(ok))
+        tally = gp.tally(np.asarray(planes))
+        assert (ok == want_ok).all(), (n_target, ok)
+        assert (tally == want_tally).all(), (n_target, tally,
+                                             want_tally)
+
+
 def main(which):
     {"tally": run_tally, "graft": run_graft, "rlc": run_rlc,
-     "blocksync": run_blocksync}[which]()
+     "blocksync": run_blocksync, "equiv": run_equiv,
+     "refactor": run_refactor}[which]()
     print("OK", which)
 
 

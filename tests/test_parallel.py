@@ -40,3 +40,16 @@ def test_sharded_rlc_fast_path_and_attribution():
 
 def test_blocksync_through_mesh():
     _run("blocksync", timeout=1800)
+
+
+def test_mesh_executor_matches_single_chip():
+    """ISSUE 12 acceptance: sharded and single-chip verdicts identical
+    on clean / tampered / valset-change chains, then a pipelined
+    catch-up with the MeshExecutor as the real verify backend."""
+    _run("equiv", timeout=1800)
+
+
+def test_mesh_refactor_matrix_exact_tally():
+    """8 -> 6 -> 4 -> 1-device factorings via topology masking: the
+    int64 power tally stays bit-exact across every factoring."""
+    _run("refactor", timeout=1800)

@@ -46,6 +46,12 @@ python tools/sim_run.py --selftest || rc=$?
 echo "=== device-flap / device-corrupt quick sweeps ===" >&2
 python tools/sim_run.py --scenario device-flap --seeds 0..4 --quick || rc=$?
 python tools/sim_run.py --scenario device-corrupt --seeds 0..4 --quick || rc=$?
+# per-shard mesh health (mesh/shard_health): a corrupt shard must
+# quarantine + re-factor the mesh smaller, the sync must complete with
+# zero corrupt verdicts surfaced, and the re-probe must grow it back —
+# byte-identical per seed
+echo "=== mesh-degrade quick sweep ===" >&2
+python tools/sim_run.py --scenario mesh-degrade --seeds 0..4 --quick || rc=$?
 # light-farm smoke: the scenario sweep pins determinism + the spec
 # oracle; the bench A/B proves coalescing still beats N sequential
 # clients (tiny config — the PERF.md datum is the N=32 run)

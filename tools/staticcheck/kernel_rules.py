@@ -33,6 +33,13 @@ FLAGGED inside traced scope:
 
 Host-side helpers in ops/ that no entry reaches (batch marshalling,
 table precomputation, module constants) are deliberately out of scope.
+
+SCOPE: ops/ plus the mesh data plane — parallel/ and mesh/ hold the
+jit entries of the sharded production path (make_*_sharded_verifier's
+nested @jax.jit closures) and their shard_map-mapped bodies, which
+run per-device under exactly the same int32 contract. `shard_map` is
+a tracing wrapper here (callable arg 0), including the from-imported
+`_shard_map` alias parallel/verify uses across the jax API rename.
 """
 
 from __future__ import annotations
@@ -43,6 +50,8 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 from . import FileCtx, Finding
 
 OPS_PREFIX = "cometbft_tpu/ops/"
+KERNEL_PREFIXES = (OPS_PREFIX, "cometbft_tpu/parallel/",
+                   "cometbft_tpu/mesh/")
 
 _JIT_NAMES = {"jax.jit", "jax.api.jit"}
 _WRAP_ARGPOS = {          # callable-arg positions of tracing wrappers
@@ -51,9 +60,11 @@ _WRAP_ARGPOS = {          # callable-arg positions of tracing wrappers
     "while_loop": (0, 1),
     "pallas_call": (0,),
     "cond": (1, 2),
+    "shard_map": (0,),
 }
 _WRAP_MODULES = ("jax.lax", "jax", "jax.experimental.pallas",
-                 "jax.experimental.pallas.tpu")
+                 "jax.experimental.pallas.tpu",
+                 "jax.experimental.shard_map")
 _BAD_DTYPES = {"int64", "uint64", "float64"}
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
 _COERCIONS = {"int", "float", "bool"}
@@ -89,7 +100,8 @@ class KernelDisciplineRule:
            "dtype inside ops/ code reachable from a jax.jit / "
            "lax.scan / pallas entry — the int32 TPU contract "
            "(ops/field.py, docs/STATICCHECK.md)")
-    roots: Tuple[str, ...] = (OPS_PREFIX.rstrip("/"),)
+    roots: Tuple[str, ...] = tuple(p.rstrip("/")
+                                   for p in KERNEL_PREFIXES)
     exempt: frozenset = frozenset()
     tree_rule = True
     needs_project = True
@@ -97,7 +109,7 @@ class KernelDisciplineRule:
     def applies_to(self, path: str) -> bool:
         if path in self.exempt:
             return False
-        return path.startswith(OPS_PREFIX)
+        return path.startswith(KERNEL_PREFIXES)
 
     def check(self, ctx: FileCtx):
         return ()
@@ -129,6 +141,15 @@ class KernelDisciplineRule:
 
     def _wrap_positions(self, ctx: FileCtx,
                         fn: ast.AST) -> Optional[Tuple[int, ...]]:
+        if isinstance(fn, ast.Name):
+            # from-imported wrapper (`from jax import shard_map as
+            # _shard_map`): resolve the alias to its dotted origin
+            dn = ctx.from_imports.get(fn.id)
+            if dn is not None and dn.startswith("jax"):
+                leaf = dn.rsplit(".", 1)[-1]
+                if leaf in _WRAP_ARGPOS:
+                    return _WRAP_ARGPOS[leaf]
+            return None
         if not isinstance(fn, ast.Attribute) \
                 or fn.attr not in _WRAP_ARGPOS:
             return None

@@ -209,16 +209,21 @@ class ReactorSleepRule(Rule):
     timesource seams or an event wait."""
     name = "reactor-sleep"
     doc = ("time.sleep() in consensus//pipeline//engine//farm//ingest//"
-           "aggsig — use the ticker seam, an Event wait, or the async "
-           "form")
+           "aggsig//mesh — use the ticker seam, an Event wait, or the "
+           "async form")
     # farm/ and ingest/: RPC worker threads block on batcher/ticket
     # Events; a raw sleep there would both stall coalescing and break
     # the light-farm / flash-crowd scenarios' determinism. aggsig/:
     # commit verification runs inline in consensus handlers and the
-    # blocksync marshal stage — a sleep there stalls the round
+    # blocksync marshal stage — a sleep there stalls the round.
+    # mesh/: the dispatch loop serializes every tile; a sleep there
+    # stalls K-per-shard pipelining, and the shard supervisor's probe
+    # windows flow through timesource for the mesh-degrade scenario's
+    # determinism
     roots = ("cometbft_tpu/consensus", "cometbft_tpu/pipeline",
              "cometbft_tpu/engine", "cometbft_tpu/farm",
-             "cometbft_tpu/ingest", "cometbft_tpu/aggsig")
+             "cometbft_tpu/ingest", "cometbft_tpu/aggsig",
+             "cometbft_tpu/mesh")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
@@ -318,16 +323,17 @@ class BareExceptRule(Rule):
     KeyboardInterrupt/SystemExit and masks wedge signatures the
     watchdog and supervisor key off — name the exceptions."""
     name = "bare-except"
-    doc = ("bare `except:` in device/, pipeline/, farm/, ingest/, or "
-           "aggsig/ — catch named exception types so wedge/corruption "
-           "signals propagate")
+    doc = ("bare `except:` in device/, pipeline/, farm/, ingest/, "
+           "aggsig/, or mesh/ — catch named exception types so "
+           "wedge/corruption signals propagate")
     # farm/ and ingest/ dispatch through the same device seam: a
     # swallowed canary/transport signal would hide corruption from the
     # supervisor; aggsig/'s FinalExpChecker rides the same canary/
-    # quarantine discipline
+    # quarantine discipline; mesh/'s per-shard canary checks and
+    # probe errors are exactly the signals shard quarantine keys off
     roots = ("cometbft_tpu/device", "cometbft_tpu/pipeline",
              "cometbft_tpu/farm", "cometbft_tpu/ingest",
-             "cometbft_tpu/aggsig")
+             "cometbft_tpu/aggsig", "cometbft_tpu/mesh")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
