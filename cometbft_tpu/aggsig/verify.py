@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..crypto import bls12381 as bls
 from ..libs.env import env_bool
+from ..trace import shared_tracer
 from ..types.validation import (CommitVerificationError,
                                 ErrNotEnoughVotingPowerSigned,
                                 ErrWrongSignature)
@@ -333,11 +334,13 @@ def prepare_full_commit(chain_id: str, vals, commit, needed: int,
     """FULL verify_commit semantics (absent ignored, every included
     signature checked, for-block power > 2/3) marshaled into an
     AggSeal — the aggregate analog of blocksync's lane marshal."""
-    status, payload = _prepare(
-        chain_id, vals, commit, needed,
-        ignore=lambda c: c.absent_(),
-        count=lambda c: c.for_block(),
-        lookup_by_index=True, cache=cache)
+    with shared_tracer().start("aggsig.marshal") as span:
+        status, payload = _prepare(
+            chain_id, vals, commit, needed,
+            ignore=lambda c: c.absent_(),
+            count=lambda c: c.for_block(),
+            lookup_by_index=True, cache=cache)
+        span.set_attr("status", status)
     return AggSeal(status, payload)
 
 
@@ -349,8 +352,10 @@ def settle_seals(seals: Sequence[AggSeal], cache=None,
     pend = [i for i, s in enumerate(seals) if s.status == "pend"]
     verdicts = [s.status == "ok" for s in seals]
     if pend:
-        oks = (checker or shared_finalexp()).check(
-            [seals[i].payload[0] for i in pend])
+        with shared_tracer().start("aggsig.settle", seals=len(seals),
+                                   pending=len(pend)):
+            oks = (checker or shared_finalexp()).check(
+                [seals[i].payload[0] for i in pend])
         for i, ok in zip(pend, oks):
             verdicts[i] = bool(ok)
             if ok and cache is not None:

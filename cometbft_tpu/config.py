@@ -313,6 +313,17 @@ class GRPCConfig:
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_laddr: str = ""
+    # flight-recorder tracing (docs/TRACE.md): spans land in a bounded
+    # in-memory ring, dumped as JSONL on watchdog-trip / canary-failure
+    # / shard-quarantine / shed-burst. Off by default — the disabled
+    # path costs one attribute read per would-be span.
+    trace: bool = False
+    trace_ring: int = 4096             # ring capacity in spans
+    trace_dump_dir: str = ""           # "" = in-memory dumps only
+
+    def validate_basic(self) -> None:
+        if self.trace_ring < 1:
+            raise ValueError("instrumentation.trace_ring must be >= 1")
 
 
 @dataclass
@@ -361,6 +372,7 @@ class Config:
         self.storage.validate_basic()
         self.tx_index.validate_basic()
         self.grpc.validate_basic()
+        self.instrumentation.validate_basic()
 
     def path(self, rel: str) -> str:
         return os.path.join(self.root_dir, rel)

@@ -14,6 +14,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..libs.env import env_float
+from ..trace.context import ctx_of
 from . import health
 from .protocol import (decode_response, encode_request, recv_frame,
                        send_frame)
@@ -141,12 +142,16 @@ class DeviceClient:
                 self._pending.clear()
 
     def submit(self, pubs: List[bytes], msgs: List[bytes],
-               sigs: List[bytes]) -> DeviceFuture:
+               sigs: List[bytes], ctx=None) -> DeviceFuture:
         """Non-blocking dispatch: frame the batch onto the wire and
         return a future the receive thread resolves — the seam the
-        verification pipeline keeps K tiles in flight through."""
+        verification pipeline keeps K tiles in flight through. `ctx`
+        (a trace Span/TraceContext) rides the request as the
+        backward-compatible trace trailer; None sends the v1 bytes."""
         if not pubs:
             raise ValueError("empty batch")
+        tctx = ctx_of(ctx)
+        trailer = tctx.to_wire() if tctx is not None else None
         req_id = next(self._ids)
         fut = DeviceFuture(self, req_id, len(pubs))
         with self._wlock:
@@ -155,7 +160,8 @@ class DeviceClient:
             self._pending[req_id] = fut._ev
             try:
                 send_frame(self._sock, encode_request(req_id, pubs,
-                                                      msgs, sigs))
+                                                      msgs, sigs,
+                                                      trace=trailer))
             except OSError as e:
                 # a timed-out/failed send may have written a PARTIAL
                 # frame — the stream is desynchronized; kill the link

@@ -261,6 +261,12 @@ class DeviceSupervisor:
 
     def report_corruption(self, detail: str = "") -> None:
         """A canary verdict mismatch: the device LIED. Terminal."""
+        # flight-recorder dump BEFORE the state flip: the ring still
+        # holds the batch spans that carried the lying canary (the
+        # QUARANTINED guard below makes later calls no-ops anyway, so
+        # one event dumps once)
+        from ..trace import trigger_dump
+        trigger_dump("canary-failure", "node", detail)
         with self._lock:
             if self._state == QUARANTINED:
                 return

@@ -9,7 +9,8 @@ Framing: every message is u32le length || payload.
 Request payload:
     req_id  u64le
     n       u32le
-    n × record: pub(32) | sig(64) | msg_len u32le | msg bytes
+    n × record: pub(32) | sig(64) | msg_len u32le | msg
+    [trace_id u64le | span_id u64le]      (optional trailer) bytes
 
 Response payload:
     req_id   u64le
@@ -25,6 +26,15 @@ compatible by construction: v1 `decode_response` reads exactly n
 verdict bytes and ignores a trailer, so old clients keep working
 against a mesh server, and `decode_response_shards` returns None for
 a single-chip server that sends no trailer.
+
+The request-side TRACE trailer follows the same stance in the other
+direction: a tracing-enabled client appends its flight-recorder
+context (trace/context.TraceContext.to_wire — two u64le ids) after the
+last lane record, so the server's flush spans can link back to the
+submitting node's causal chain. It is appended ONLY when tracing is on
+(default wire bytes are unchanged), and the v2 `decode_request`
+accepts both forms; `decode_request_trace` returns None for a v1
+request.
 
 The protocol is deliberately dumb-binary (no proto/JSON): a C caller
 can marshal it with memcpy, and the server's hot loop does one pass of
@@ -59,8 +69,12 @@ def recv_frame(sock: socket.socket, max_len: int = 64 << 20) -> bytes:
     return recv_exact(sock, ln)
 
 
+TRACE_TRAILER_LEN = 16  # trace/context.TraceContext wire form (2×u64le)
+
+
 def encode_request(req_id: int, pubs: List[bytes], msgs: List[bytes],
-                   sigs: List[bytes]) -> bytes:
+                   sigs: List[bytes],
+                   trace: Optional[bytes] = None) -> bytes:
     parts = [struct.pack("<QI", req_id, len(pubs))]
     for p, m, s in zip(pubs, msgs, sigs):
         if len(p) != 32 or len(s) != 64:
@@ -69,11 +83,19 @@ def encode_request(req_id: int, pubs: List[bytes], msgs: List[bytes],
         parts.append(s)
         parts.append(struct.pack("<I", len(m)))
         parts.append(m)
+    if trace is not None:
+        if len(trace) != TRACE_TRAILER_LEN:
+            raise ValueError(
+                f"trace trailer must be {TRACE_TRAILER_LEN} bytes")
+        parts.append(trace)
     return b"".join(parts)
 
 
-def decode_request(payload: bytes
-                   ) -> Tuple[int, List[bytes], List[bytes], List[bytes]]:
+def _walk_request(payload: bytes
+                  ) -> Tuple[int, List[bytes], List[bytes], List[bytes],
+                             int]:
+    """One pass over the lane records; returns the parse plus the
+    offset where the records end (trailer detection)."""
     try:
         req_id, n = struct.unpack_from("<QI", payload, 0)
         off = 12
@@ -87,9 +109,29 @@ def decode_request(payload: bytes
             off += mlen
     except struct.error as e:  # truncated header OR truncated record
         raise ValueError(f"malformed verify request: {e}") from e
-    if off != len(payload) or any(len(p) != 32 for p in pubs):
+    if (len(payload) - off not in (0, TRACE_TRAILER_LEN)
+            or any(len(p) != 32 for p in pubs)):
         raise ValueError("malformed verify request")
+    return req_id, pubs, msgs, sigs, off
+
+
+def decode_request(payload: bytes
+                   ) -> Tuple[int, List[bytes], List[bytes], List[bytes]]:
+    req_id, pubs, msgs, sigs, _off = _walk_request(payload)
     return req_id, pubs, msgs, sigs
+
+
+def decode_request_trace(payload: bytes) -> Optional[Tuple[int, int]]:
+    """The (trace_id, span_id) trailer, or None for a v1 request that
+    carries none (the caller already validated the frame through
+    decode_request / _walk_request; garbage still raises the same
+    ValueError)."""
+    _req_id, _pubs, _msgs, _sigs, off = _walk_request(payload)
+    tail = payload[off:]
+    if not tail:
+        return None
+    trace_id, span_id = struct.unpack("<QQ", tail)
+    return trace_id, span_id
 
 
 CPU_SHARD = 0xFF  # attribution sentinel: verdict from CPU re-verify

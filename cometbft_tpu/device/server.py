@@ -23,9 +23,11 @@ import threading
 from dataclasses import dataclass
 from typing import List, Optional
 
+from ..trace import shared_tracer
+from ..trace.context import TraceContext
 from .health import CANARY_LANES
-from .protocol import (decode_request, encode_response, recv_frame,
-                       send_frame)
+from .protocol import (decode_request, decode_request_trace,
+                       encode_response, recv_frame, send_frame)
 
 
 @dataclass
@@ -36,6 +38,7 @@ class _Job:
     pubs: List[bytes]
     msgs: List[bytes]
     sigs: List[bytes]
+    ctx: Optional[TraceContext] = None  # request trace trailer
 
 
 class DeviceServer:
@@ -141,28 +144,41 @@ class DeviceServer:
             pubs.extend(j.pubs)
             msgs.extend(j.msgs)
             sigs.extend(j.sigs)
+        # one flush serves many requests (coalescing seam): the flush
+        # span is a root that LINKS each submitting client's trailer
+        # ctx, mirroring the ingest-flush/ticket relationship
+        span = shared_tracer().start("device.flush", jobs=len(jobs),
+                                     lanes=len(pubs))
+        for j in jobs:
+            span.link(j.ctx)
         shards = None
-        if self._mesh_exec is not None:
-            # the mesh data plane: lanes sharded over every device,
-            # per-shard canaries checked inside the executor (a lying
-            # shard is masked + the batch re-verifies on CPU before
-            # any verdict reaches a client), per-lane attribution
-            # returned in the response trailer. Bounded wait + closed-
-            # executor handling: stop() can close the executor while
-            # this worker drains its final batch, and an unbounded
-            # result() would hang the flush thread forever
-            from .client import deadline_for
-            try:
-                fut = self._mesh_exec.submit(pubs, msgs, sigs)
-                oks = fut.result(deadline_for(len(pubs)))
-                shards = fut.shards
-            except (ConnectionError, TimeoutError):
-                if self._stop.is_set():
-                    return  # shutting down: clients are going away
-                raise
-        else:
-            from ..ops.ed25519 import verify_batch
-            oks = verify_batch(pubs, msgs, sigs, batch_size=self.bucket)
+        try:
+            if self._mesh_exec is not None:
+                # the mesh data plane: lanes sharded over every device,
+                # per-shard canaries checked inside the executor (a
+                # lying shard is masked + the batch re-verifies on CPU
+                # before any verdict reaches a client), per-lane
+                # attribution returned in the response trailer. Bounded
+                # wait + closed-executor handling: stop() can close the
+                # executor while this worker drains its final batch,
+                # and an unbounded result() would hang the flush thread
+                # forever
+                from .client import deadline_for
+                try:
+                    fut = self._mesh_exec.submit(pubs, msgs, sigs,
+                                                 ctx=span)
+                    oks = fut.result(deadline_for(len(pubs)))
+                    shards = fut.shards
+                except (ConnectionError, TimeoutError):
+                    if self._stop.is_set():
+                        return  # shutting down: clients are going away
+                    raise
+            else:
+                from ..ops.ed25519 import verify_batch
+                oks = verify_batch(pubs, msgs, sigs,
+                                   batch_size=self.bucket)
+        finally:
+            span.end()
         self.stats["flushes"] += 1
         self.stats["signatures"] += len(pubs)
         off = 0
@@ -246,6 +262,8 @@ class DeviceServer:
             while not self._stop.is_set():
                 payload = recv_frame(sock)
                 req_id, pubs, msgs, sigs = decode_request(payload)
+                ids = decode_request_trace(payload)
+                ctx = TraceContext(*ids) if ids is not None else None
                 self.stats["requests"] += 1
                 # oversized messages / batches can't ride the compiled
                 # bucket: answer UNPROCESSABLE (zero lanes for a
@@ -258,7 +276,7 @@ class DeviceServer:
                             req_id, False, []))
                     continue
                 self._jobs.put(_Job(sock, wlock, req_id, pubs, msgs,
-                                    sigs))
+                                    sigs, ctx))
         except (ConnectionError, OSError, ValueError):
             pass  # garbage or lost peer: drop the connection cleanly
         finally:

@@ -33,6 +33,7 @@ import numpy as np
 from ..state.execution import BlockExecutor, BlockValidationError
 from ..state.state import State
 from ..store.blockstore import BlockStore
+from ..trace import shared_tracer
 from ..types import validation
 from ..types.block import Block, BlockID
 from ..types.validator import ValidatorSet
@@ -397,42 +398,56 @@ class BlocksyncReactor:
 
     def _sync_tile(self, state: State, target: int) -> State:
         start = state.last_block_height + 1
-        fetched, end = self._fetch_range(start, target)
+        tracer = shared_tracer()
+        with tracer.start("blocksync.tile", start=start) as tspan:
+            with tracer.start("blocksync.fetch", parent=tspan):
+                fetched, end = self._fetch_range(start, target)
+            tspan.set_attr("end", end)
 
-        # speculate: per height, the valset is the tile-start set until a
-        # header announces a different validators_hash
-        cur_vals = state.validators
-        cur_hash = cur_vals.hash()
-        entries: List[TileEntry] = []
-        for h in range(start, end + 1):
-            block, _parts, bid = fetched[h]
-            if block.header.validators_hash != cur_hash:
-                break  # valset changes: verify later tiles after applying
-            entries.append(TileEntry(
-                height=h, block=block, block_id=bid, valset=cur_vals,
-                commit=fetched[h + 1][0].last_commit))
+            # speculate: per height, the valset is the tile-start set
+            # until a header announces a different validators_hash
+            cur_vals = state.validators
+            cur_hash = cur_vals.hash()
+            entries: List[TileEntry] = []
+            for h in range(start, end + 1):
+                block, _parts, bid = fetched[h]
+                if block.header.validators_hash != cur_hash:
+                    break  # valset changes: verify after applying
+                entries.append(TileEntry(
+                    height=h, block=block, block_id=bid, valset=cur_vals,
+                    commit=fetched[h + 1][0].last_commit))
 
-        if entries:
-            self.verifier.verify_tile(entries)
-            self.stats.tiles_flushed += 1
-            self.stats.sigs_verified += sum(
-                1 for e in entries for cs in e.commit.signatures
-                if not cs.absent_())
+            if entries:
+                with tracer.start("blocksync.verify", parent=tspan,
+                                  entries=len(entries)):
+                    self.verifier.verify_tile(entries)
+                self.stats.tiles_flushed += 1
+                self.stats.sigs_verified += sum(
+                    1 for e in entries for cs in e.commit.signatures
+                    if not cs.absent_())
 
-        applied_any = False
-        by_height = {e.height: e for e in entries}
-        h = start
-        while h <= end:
-            block, parts, block_id = fetched[h]
-            seal_commit = fetched[h + 1][0].last_commit
+            applied_any = False
+            by_height = {e.height: e for e in entries}
+            aspan = tracer.start("blocksync.apply", parent=tspan)
             try:
-                state = self._apply_one(state, h, block, parts, block_id,
-                                        seal_commit, by_height.get(h))
-            except TileApplyError as f:
-                self.source.ban(h)
-                if applied_any:
-                    return state  # retry the remainder in a fresh tile
-                raise BlockValidationError(str(f)) from f
-            applied_any = True
-            h += 1
-        return state
+                h = start
+                while h <= end:
+                    block, parts, block_id = fetched[h]
+                    seal_commit = fetched[h + 1][0].last_commit
+                    try:
+                        state = self._apply_one(
+                            state, h, block, parts, block_id,
+                            seal_commit, by_height.get(h))
+                    except TileApplyError as f:
+                        self.source.ban(h)
+                        aspan.event("banned", height=h)
+                        if applied_any:
+                            return state  # retry remainder next tile
+                        raise BlockValidationError(str(f)) from f
+                    applied_any = True
+                    h += 1
+                return state
+            finally:
+                aspan.set_attr("applied",
+                               state.last_block_height - start + 1)
+                aspan.end()

@@ -31,12 +31,14 @@ bench) submit a whole wave and flush once, deterministically.
 
 from __future__ import annotations
 
+import inspect
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..libs.env import env_bool, env_float, env_int
 from ..libs.fail import fail_point
 from ..pipeline.cache import SigCache
+from ..trace import shared_tracer, trigger_dump
 from ..types.validation import ErrWrongSignature
 from .planner import Lane, PlannedCheck
 
@@ -94,12 +96,15 @@ class QueueFull(Exception):
 
 
 class CheckTicket:
-    """Handle for one submitted PlannedCheck; resolved by a flush."""
+    """Handle for one submitted PlannedCheck; resolved by a flush.
+    `ctx` is the submitter's trace context — the explicit propagation
+    handle the coalesced flush span links (never a thread-local)."""
 
-    def __init__(self, planned: PlannedCheck):
+    def __init__(self, planned: PlannedCheck, ctx=None):
         self.planned = planned
         self.error: Optional[Exception] = None
         self._ev = threading.Event()
+        self.ctx = ctx  # trace.TraceContext or None
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -159,17 +164,54 @@ def _fallback_verify(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
     return _native_verify(lanes)
 
 
-def device_or_cpu_backend(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
+def _mesh_verify(lanes: Sequence[Lane],
+                 ctx=None) -> Optional[Tuple[List[bool], str]]:
+    """Route a batch through the process-wide MeshExecutor when the
+    node owns its mesh in-process (no device server configured but
+    [device] mesh is on): the same submit()/future seam the pipeline
+    rides, per-shard canaries + CPU re-verify inside the executor —
+    verdict safety is the executor's own contract, so no second canary
+    splice here. Returns None when no shared executor is serving (the
+    caller falls through to the kernel/native ladder); overload and
+    transport failures also fall through — the farm must degrade, not
+    shed, exactly like a dead device server."""
+    from .. import mesh
+    if not mesh.mesh_enabled():
+        return None
+    ex = mesh.shared_executor()
+    if ex is None:
+        return None
+    from ..device.client import deadline_for
+    from ..mesh import MeshOverloaded
+    pubs = [lane.pub for lane in lanes]
+    msgs = [lane.msg for lane in lanes]
+    sigs = [lane.sig for lane in lanes]
+    try:
+        oks = ex.submit(pubs, msgs, sigs,
+                        ctx=ctx).result(deadline_for(len(pubs)))
+    except (MeshOverloaded, TimeoutError, ConnectionError, OSError):
+        return None
+    return [bool(v) for v in oks], "mesh"
+
+
+def device_or_cpu_backend(lanes: Sequence[Lane],
+                          ctx=None) -> Tuple[List[bool], str]:
     """Default verify backend: the DeviceClient.submit() seam with
     canary lanes + supervisor gating (the RemoteBatchVerifier contract,
     restated here because the farm attributes device-vs-CPU verdicts
-    per batch), CPU per-sig otherwise."""
+    per batch); without a device server, the shared in-process mesh
+    executor when one is serving (lanes_verified{backend="mesh"}); CPU
+    per-sig otherwise. `ctx` is the flush span's trace context,
+    forwarded through whichever submit seam is taken."""
     from ..device import health
     from ..device.client import DeviceUnprocessable, shared_client
     if any(lane.pk.type_() != ED25519 for lane in lanes):
         return _native_verify(lanes)  # kernels are ed25519-only
     client = shared_client()
     if client is None:
+        got = _mesh_verify(lanes, ctx=ctx)
+        if got is not None:
+            return got
         return _fallback_verify(lanes)
     sup = health.shared_supervisor()
     if not sup.allow_connect():
@@ -181,7 +223,7 @@ def device_or_cpu_backend(lanes: Sequence[Lane]) -> Tuple[List[bool], str]:
     if canaried:
         pubs, msgs, sigs = health.splice_canaries(pubs, msgs, sigs)
     try:
-        _ok, oks = client.submit(pubs, msgs, sigs).result()
+        _ok, oks = client.submit(pubs, msgs, sigs, ctx=ctx).result()
     except DeviceUnprocessable:
         return _native_verify(lanes)
     except (TimeoutError, ConnectionError, OSError) as e:
@@ -206,6 +248,7 @@ class FarmBatcher:
     """Bounded, coalescing, deduplicating verify queue."""
 
     # guarded-by: _lock: _tickets, _pending_lanes, shed
+    # guarded-by: _lock: _shed_burst_open
     # guarded-by: _flush_lock: batches, dedup_batch_hits, lanes_by_backend
     # guarded-by: _flush_lock: last_batch_width, max_batch_width
     # (flow-aware: _run_batch only runs from flush() under _flush_lock,
@@ -232,6 +275,10 @@ class FarmBatcher:
         self.cache = cache if cache is not None else SigCache(0)
         self.metrics = metrics  # libs/metrics_gen.FarmMetrics or None
         self._backend = verify_backend or device_or_cpu_backend
+        # ctx propagation is opt-in per backend (injected test/sim
+        # backends keep the plain (lanes) signature) — decided once
+        self._backend_takes_ctx = (
+            "ctx" in inspect.signature(self._backend).parameters)
         self._lock = threading.Lock()
         self._flush_lock = threading.Lock()
         self._tickets: List[CheckTicket] = []
@@ -243,14 +290,19 @@ class FarmBatcher:
         self.shed = 0
         self.last_batch_width = 0
         self.max_batch_width = 0
+        # shed storms dump the flight recorder once per burst (ingest
+        # discipline): opens at the first shed, closes on a flush
+        self._shed_burst_open = False
 
     # --- intake -----------------------------------------------------------
 
-    def submit(self, planned: PlannedCheck) -> CheckTicket:
+    def submit(self, planned: PlannedCheck, ctx=None) -> CheckTicket:
         """Queue one check; QueueFull once the lane budget is spent.
         A check with no pending lanes (all cache hits) resolves
-        immediately — the dedup fast path costs no queue space."""
-        ticket = CheckTicket(planned)
+        immediately — the dedup fast path costs no queue space. `ctx`
+        is the submitter's trace context; it rides the ticket so the
+        coalesced flush span can link back to the request."""
+        ticket = CheckTicket(planned, ctx=ctx)
         if not planned.lanes:
             ticket._ev.set()
             return ticket
@@ -260,6 +312,11 @@ class FarmBatcher:
                 self.shed += 1
                 if self.metrics is not None:
                     self.metrics.shed.inc()
+                if not self._shed_burst_open:
+                    self._shed_burst_open = True
+                    trigger_dump(
+                        "shed-burst", f"farm:{self.shed}",
+                        f"lane budget {self.max_pending_lanes} spent")
                 raise QueueFull(
                     f"farm verify queue full "
                     f"({self._pending_lanes} lanes pending)")
@@ -308,6 +365,7 @@ class FarmBatcher:
             with self._lock:
                 tickets, self._tickets = self._tickets, []
                 self._pending_lanes = 0
+                self._shed_burst_open = False  # storm (if any) is over
             if not tickets:
                 return 0
             fail_point("farm:flush")
@@ -339,7 +397,19 @@ class FarmBatcher:
                     if self.metrics is not None:
                         self.metrics.dedup_hits.inc(kind="batch")
                     owners[at].append((ticket, lane))
-        oks, backend = self._backend(unique)
+        # coalescing seam: one flush serves many submitters — a root
+        # span linking each ticket's submit-side context
+        tracer = shared_tracer()
+        with tracer.start("farm.flush", tickets=len(tickets),
+                          lanes=len(unique)) as span:
+            if tracer.enabled:
+                for ticket in tickets:
+                    span.link(ticket.ctx)
+            if self._backend_takes_ctx:
+                oks, backend = self._backend(unique, ctx=span)
+            else:
+                oks, backend = self._backend(unique)
+            span.set_attr("backend", backend)
         if len(oks) != len(unique):
             raise RuntimeError(
                 f"verify backend answered {len(oks)} lanes "

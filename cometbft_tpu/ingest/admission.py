@@ -44,6 +44,7 @@ from ..libs.env import env_bool, env_float, env_int
 from ..libs.fail import fail_point
 from ..mempool.mempool import tx_key
 from ..pipeline.cache import SigCache
+from ..trace import shared_tracer, trigger_dump
 from .batcher import IngestBatcher, SigLane
 from .dispatcher import VerdictDispatcher
 from .tx import MalformedTx, parse_signed_tx, sign_bytes
@@ -113,12 +114,15 @@ class TxFilter:
 class TxTicket:
     """Handle for one submitted tx; resolved when its batch settles.
     Exactly one of `code` (admission verdict, 0 = admitted) or `error`
-    (structural ValueError — full/too-large/duplicate) is set."""
+    (structural ValueError — full/too-large/duplicate) is set. `ctx`
+    is the tx's admit-span trace context (None with tracing off) —
+    the EXPLICIT propagation handle the coalesced flush span links."""
 
-    __slots__ = ("tx", "key", "lane", "code", "error", "_ev", "t_submit")
+    __slots__ = ("tx", "key", "lane", "code", "error", "_ev", "t_submit",
+                 "ctx")
 
     def __init__(self, tx: bytes, key: bytes,
-                 lane: Optional[SigLane], t_submit: float):
+                 lane: Optional[SigLane], t_submit: float, ctx=None):
         self.tx = tx
         self.key = key
         self.lane = lane
@@ -126,6 +130,7 @@ class TxTicket:
         self.error: Optional[Exception] = None
         self._ev = threading.Event()
         self.t_submit = t_submit
+        self.ctx = ctx  # trace.TraceContext or None
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -138,6 +143,7 @@ class IngestPipeline:
     """Bounded, coalescing, deduplicating tx admission front door."""
 
     # guarded-by: _lock: _tickets, _latencies, shed, dup_hits
+    # guarded-by: _lock: _shed_burst_open
     # (flow-aware: _shed_locked is only ever reached from submit()
     # under `with self._lock`, so its shed/filter bookkeeping needs no
     # pragma — the lock rides in from the caller)
@@ -180,6 +186,11 @@ class IngestPipeline:
         self._latencies: "deque[float]" = deque(maxlen=LATENCY_SAMPLES)
         self.shed = 0
         self.dup_hits = 0
+        # a shed STORM is one event, not one per bounced tx: the burst
+        # opens at the first shed (one flight-recorder dump, keyed by
+        # the shed count at open) and closes when a flush drains the
+        # queue — the next storm is a new event
+        self._shed_burst_open = False
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # post-commit recheck / update / flush evictions must release
@@ -191,60 +202,71 @@ class IngestPipeline:
 
     # --- intake -----------------------------------------------------------
 
-    def submit(self, tx: bytes) -> TxTicket:
+    def submit(self, tx: bytes, ctx=None) -> TxTicket:
         """Queue one tx (or, in sequential mode, admit it inline).
         Raises IngestShed when the queue is full, ValueError on a
         duplicate or malformed envelope — the same exception surface
-        the sequential mempool path presents to RPC."""
+        the sequential mempool path presents to RPC. `ctx` is the
+        caller's trace context (the RPC root span); the tx's admit
+        span becomes its child and rides the ticket into the flush."""
         t0 = self._clock()
-        key = tx_key(tx)
-        if not self.filter.push(key):
-            # under the lock: concurrent RPC workers flooding the same
-            # tx would lose read-modify-write increments otherwise
-            with self._lock:
-                self.dup_hits += 1
-            if self.metrics is not None:
-                self.metrics.dedup_hits.inc(kind="txhash")
-            raise ValueError("tx already in cache")
+        span = shared_tracer().start("ingest.admit", parent=ctx)
         try:
-            parsed = parse_signed_tx(tx)
-        except MalformedTx:
-            # structurally invalid forever, but mirror the mempool's
-            # invalid-tx cache eviction so the filter cannot pin state
-            # for garbage bytes
-            self.filter.remove(key)
-            raise
-        lane = None
-        if parsed is not None:
-            msg = sign_bytes(parsed.payload)
-            if not self.cache.seen(parsed.pub, msg, parsed.sig,
-                                   path=CACHE_PATH):
-                lane = SigLane(parsed.pub, msg, parsed.sig,
-                               self.cache.key(parsed.pub, msg,
-                                              parsed.sig))
-        ticket = TxTicket(tx, key, lane, t0)
-        if not self.batch:
-            # sequential baseline: verify this tx's lane natively and
-            # apply immediately — the depth-1 degenerate case
-            sig_ok = True
-            if lane is not None:
-                sig_ok = lane.pk.verify_signature(lane.msg, lane.sig)
-                if sig_ok:
-                    self.cache.add(lane.pub, lane.msg, lane.sig)
-            self.dispatcher.apply(ticket, sig_ok)
-            self._observe(ticket)
-            return ticket
-        with self._lock:
-            if len(self._tickets) >= self.max_pending:
+            key = tx_key(tx)
+            if not self.filter.push(key):
+                # under the lock: concurrent RPC workers flooding the
+                # same tx would lose read-modify-write increments
+                # otherwise
+                with self._lock:
+                    self.dup_hits += 1
+                if self.metrics is not None:
+                    self.metrics.dedup_hits.inc(kind="txhash")
+                span.set_attr("outcome", "duplicate")
+                raise ValueError("tx already in cache")
+            try:
+                parsed = parse_signed_tx(tx)
+            except MalformedTx:
+                # structurally invalid forever, but mirror the
+                # mempool's invalid-tx cache eviction so the filter
+                # cannot pin state for garbage bytes
+                self.filter.remove(key)
+                span.set_attr("outcome", "malformed")
+                raise
+            lane = None
+            if parsed is not None:
+                msg = sign_bytes(parsed.payload)
+                if not self.cache.seen(parsed.pub, msg, parsed.sig,
+                                       path=CACHE_PATH):
+                    lane = SigLane(parsed.pub, msg, parsed.sig,
+                                   self.cache.key(parsed.pub, msg,
+                                                  parsed.sig))
+            ticket = TxTicket(tx, key, lane, t0, ctx=span.ctx)
+            if not self.batch:
+                # sequential baseline: verify this tx's lane natively
+                # and apply immediately — the depth-1 degenerate case
+                sig_ok = True
+                if lane is not None:
+                    sig_ok = lane.pk.verify_signature(lane.msg, lane.sig)
+                    if sig_ok:
+                        self.cache.add(lane.pub, lane.msg, lane.sig)
+                self.dispatcher.apply(ticket, sig_ok)
+                self._observe(ticket)
+                return ticket
+            with self._lock:
+                if len(self._tickets) >= self.max_pending:
+                    depth = len(self._tickets)
+                    self._shed_locked(key)
+                    span.set_attr("outcome", "shed")
+                    raise IngestShed(
+                        f"admission queue full ({depth} txs pending)")
+                self._tickets.append(ticket)
                 depth = len(self._tickets)
-                self._shed_locked(key)
-                raise IngestShed(
-                    f"admission queue full ({depth} txs pending)")
-            self._tickets.append(ticket)
-            depth = len(self._tickets)
-        if self.metrics is not None:
-            self.metrics.queue_depth.set(depth)
-        return ticket
+            if self.metrics is not None:
+                self.metrics.queue_depth.set(depth)
+            span.set_attr("depth", depth)
+            return ticket
+        finally:
+            span.end()
 
     def _shed_locked(self, key: bytes) -> None:
         # caller holds _lock; release the filter entry — a shed is
@@ -253,15 +275,20 @@ class IngestPipeline:
         self.filter.remove(key)
         if self.metrics is not None:
             self.metrics.shed.inc()
+        if not self._shed_burst_open:
+            self._shed_burst_open = True
+            trigger_dump("shed-burst", f"ingest:{self.shed}",
+                         f"admission queue full at {self.max_pending}")
 
-    def submit_nowait(self, tx: bytes) -> Optional[TxTicket]:
+    def submit_nowait(self, tx: bytes,
+                      ctx=None) -> Optional[TxTicket]:
         """Fire-and-forget intake for p2p-relayed txs: duplicates,
         sheds, and malformed envelopes are dropped silently (the
         reference reactor only logs), and nobody blocks the p2p read
         loop waiting for the batch — the background flusher (or the
         next RPC waiter) settles the ticket."""
         try:
-            return self.submit(tx)
+            return self.submit(tx, ctx=ctx)
         except (IngestShed, ValueError):
             return None
 
@@ -293,14 +320,27 @@ class IngestPipeline:
         with self._flush_lock:
             with self._lock:
                 tickets, self._tickets = self._tickets, []
+                # the storm (if any) is over once a flush drains the
+                # queue; the next shed opens a fresh burst event
+                self._shed_burst_open = False
             if self.metrics is not None:
                 self.metrics.queue_depth.set(0)
             if not tickets:
                 return 0
             fail_point("ingest:flush")
+            # the flush is a coalescing seam: many admit spans (one
+            # per RPC root) feed ONE flush — so the flush span is a
+            # new root that LINKS every ticket's admit span, and
+            # causal_chain hops the link back to the rpc root
+            tracer = shared_tracer()
+            span = tracer.start("ingest.flush", tickets=len(tickets))
+            if tracer.enabled:
+                for ticket in tickets:
+                    span.link(ticket.ctx)
             try:
                 lanes = [t.lane for t in tickets if t.lane is not None]
-                verdicts = self.batcher.verify(lanes)
+                verdicts = self.batcher.verify(lanes, ctx=span)
+                span.set_attr("lanes", len(lanes))
                 for ticket in tickets:
                     sig_ok = (verdicts[ticket.lane.key]
                               if ticket.lane is not None else True)
@@ -314,6 +354,8 @@ class IngestPipeline:
                         ticket.error = e
                         ticket._ev.set()
                 raise
+            finally:
+                span.end()
 
     # --- background flusher (node runtime; deterministic drivers flush
     # explicitly and never start it) --------------------------------------

@@ -15,11 +15,13 @@ lane, and returns a verdict per lane key.
 
 from __future__ import annotations
 
+import inspect
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..farm.batcher import device_or_cpu_backend
 from ..pipeline.cache import SigCache
+from ..trace import shared_tracer
 
 
 @dataclass(frozen=True)
@@ -55,6 +57,12 @@ class IngestBatcher:
         self.cache = cache
         self.metrics = metrics  # libs/metrics_gen.IngestMetrics or None
         self._backend = verify_backend or device_or_cpu_backend
+        # ctx propagation is opt-in per backend: injected test/sim
+        # backends keep their plain (lanes) signature, the real
+        # device_or_cpu_backend takes ctx= — decided ONCE here, not
+        # with a TypeError-masking try/except per flush
+        self._backend_takes_ctx = (
+            "ctx" in inspect.signature(self._backend).parameters)
         # monotonic stats (bench_ingest and the flash-crowd log read
         # them; single-writer: the pipeline serializes flushes)
         self.batches = 0
@@ -63,11 +71,13 @@ class IngestBatcher:
         self.dedup_batch_hits = 0
         self.lanes_by_backend: Dict[str, int] = {}
 
-    def verify(self, lanes: Sequence[SigLane]) -> Dict[bytes, bool]:
+    def verify(self, lanes: Sequence[SigLane],
+               ctx=None) -> Dict[bytes, bool]:
         """Verdict per unique lane key for everything in `lanes`.
         Identical lanes are verified once; verified-TRUE triples land
         in the SigCache. An empty lane list costs nothing (a batch of
-        bare/cache-hit txs dispatches no device work)."""
+        bare/cache-hit txs dispatches no device work). `ctx` is the
+        flush span's trace context, forwarded to a ctx-aware backend."""
         if not lanes:
             return {}
         unique: List[SigLane] = []
@@ -80,7 +90,13 @@ class IngestBatcher:
                 self.dedup_batch_hits += 1
                 if self.metrics is not None:
                     self.metrics.dedup_hits.inc(kind="batch")
-        oks, backend = self._backend(unique)
+        with shared_tracer().start("ingest.verify", parent=ctx,
+                                   lanes=len(unique)) as span:
+            if self._backend_takes_ctx:
+                oks, backend = self._backend(unique, ctx=span)
+            else:
+                oks, backend = self._backend(unique)
+            span.set_attr("backend", backend)
         if len(oks) != len(unique):
             raise RuntimeError(
                 f"verify backend answered {len(oks)} lanes "

@@ -170,6 +170,20 @@ METRICS_SPEC = {
          "canary-failure re-verify or the cold-shape fallback while a "
          "re-factored mesh compiles in the background)", ("backend",)),
     ],
+    # trace/ — the flight-recorder span pipeline (trace/span.py,
+    # recorder.py): bounded ring occupancy, drop-oldest evictions, and
+    # dump-on-trigger counts by trigger kind (docs/TRACE.md)
+    "TraceMetrics": [
+        ("counter", "spans", "trace_spans_recorded",
+         "Spans recorded into the flight-recorder ring", ()),
+        ("counter", "dropped", "trace_spans_dropped",
+         "Spans evicted from the full ring (drop-oldest)", ()),
+        ("counter", "dumps", "trace_dumps_total",
+         "Flight-recorder dumps, by trigger kind (watchdog-trip, "
+         "canary-failure, shard-quarantine, shed-burst)", ("kind",)),
+        ("gauge", "ring_occupancy", "trace_ring_occupancy",
+         "Spans currently resident in the flight-recorder ring", ()),
+    ],
     # reference mempool/metrics.go
     "MempoolMetrics": [
         ("gauge", "size", "mempool_size",

@@ -64,3 +64,14 @@ def monotonic() -> float:
     if _virtual_now_ns is not None:
         return _virtual_now_ns() / 1e9
     return _time.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    """Polling-loop pause. Real sleep on wall clocks; under a virtual
+    source a short REAL yield instead — the loop's deadline math reads
+    the virtual clock, so blocking this thread for virtual seconds
+    would deadlock the simulator that owns clock advancement."""
+    if _virtual_now_ns is not None:
+        _time.sleep(0.001)
+        return
+    _time.sleep(seconds)

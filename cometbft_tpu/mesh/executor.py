@@ -45,6 +45,7 @@ import numpy as np
 from ..device.health import CANARY_LANES
 from ..device.protocol import CPU_SHARD
 from ..libs.jax_cache import ledger
+from ..trace import shared_tracer
 from .planner import (LanePlan, lanes_kernel_name, plan_lanes,
                       shard_width_for)
 from .shard_health import ShardSupervisor
@@ -322,10 +323,12 @@ class MeshExecutor:
     # --- the submit seam --------------------------------------------------
 
     def submit(self, pubs: Sequence[bytes], msgs: Sequence[bytes],
-               sigs: Sequence[bytes]) -> MeshFuture:
+               sigs: Sequence[bytes], ctx=None) -> MeshFuture:
         """Non-blocking dispatch; raises MeshOverloaded when the
         bounded queue is full (the caller sheds or verifies locally —
-        never silent unbounded queueing)."""
+        never silent unbounded queueing). `ctx` is the submitter's
+        trace context (Span/TraceContext/None) — it rides the queue
+        tuple to the dispatch thread, never a thread-local."""
         if not pubs:
             raise ValueError("empty batch")
         if self._stop.is_set():
@@ -343,14 +346,15 @@ class MeshExecutor:
             self._maybe_probe()
             try:
                 out, shards = self._dispatch(list(pubs), list(msgs),
-                                             list(sigs))
+                                             list(sigs), ctx=ctx)
                 fut.shards = shards
                 fut.set_result(out)
             except BaseException as e:  # noqa: BLE001 — via future
                 fut.set_exception(e)
             return fut
         try:
-            self._q.put_nowait((fut, list(pubs), list(msgs), list(sigs)))
+            self._q.put_nowait((fut, list(pubs), list(msgs), list(sigs),
+                                ctx))
         except queue.Full:
             raise MeshOverloaded(
                 f"mesh dispatch queue full "
@@ -394,62 +398,83 @@ class MeshExecutor:
                 continue
             if item is None:
                 return
-            fut, pubs, msgs, sigs = item
+            fut, pubs, msgs, sigs, ctx = item
             self._maybe_probe()
             if fut._cancelled:
                 continue
             try:
-                out, shards = self._dispatch(pubs, msgs, sigs)
+                out, shards = self._dispatch(pubs, msgs, sigs, ctx=ctx)
                 fut.shards = shards
                 fut.set_result(out)
             except BaseException as e:  # noqa: BLE001 — surfaced via
                 # the future; the pipeline watchdog / caller decides
                 fut.set_exception(e)
 
-    def _dispatch(self, pubs, msgs, sigs
+    def _dispatch(self, pubs, msgs, sigs, ctx=None
                   ) -> Tuple[List[bool], List[int]]:
         if self._backend is None:
             self._backend = JaxMeshBackend()
         view = self.topology.view()
         plan = plan_lanes(len(pubs), view.n_shards, self.canary)
-        be = self._jax_backend()
-        if be is not None and not be.is_warm(view, plan, msgs):
-            # a shape this process never compiled (a just-degraded or
-            # just-regrown factoring whose bucket the boot warm could
-            # not know): NEVER compile it on the live dispatch thread
-            # — minutes of XLA would stall every tile and trip the
-            # watchdog. Serve this batch on the trusted native path
-            # and compile the new shape in the background; dispatches
-            # re-enter the mesh once it is warm.
-            self._warm_in_background(view, plan, pubs, msgs, sigs)
+        tracer = shared_tracer()
+        with tracer.start("mesh.dispatch", parent=ctx,
+                          lanes=len(pubs),
+                          shards=view.n_shards) as span:
+            be = self._jax_backend()
+            if be is not None and not be.is_warm(view, plan, msgs):
+                # a shape this process never compiled (a just-degraded
+                # or just-regrown factoring whose bucket the boot warm
+                # could not know): NEVER compile it on the live
+                # dispatch thread — minutes of XLA would stall every
+                # tile and trip the watchdog. Serve this batch on the
+                # trusted native path and compile the new shape in the
+                # background; dispatches re-enter the mesh once it is
+                # warm.
+                self._warm_in_background(view, plan, pubs, msgs, sigs)
+                if self.metrics is not None:
+                    self.metrics.tiles.inc()
+                    self.metrics.lanes.inc(len(pubs), backend="cpu")
+                with tracer.start("mesh.cpu_reverify", parent=span,
+                                  reason="cold-shape"):
+                    out = _native_verify(pubs, msgs, sigs)
+                return out, [CPU_SHARD] * len(pubs)
+            if tracer.enabled:
+                # per-shard child spans: how the plan factored this
+                # batch over the serving view (lane counts per shard)
+                per_shard = [0] * view.n_shards
+                for i in range(len(pubs)):
+                    per_shard[plan.shard_of(i)] += 1
+                for s, n in enumerate(per_shard):
+                    tracer.start("mesh.shard", parent=span,
+                                 shard=view.shard_ids[s], lanes=n).end()
+            padded = plan.build(pubs, msgs, sigs)
+            rows = self._backend(view, plan, *padded)
+            real, bad_shards = plan.extract(rows)
             if self.metrics is not None:
                 self.metrics.tiles.inc()
-                self.metrics.lanes.inc(len(pubs), backend="cpu")
-            return (_native_verify(pubs, msgs, sigs),
-                    [CPU_SHARD] * len(pubs))
-        padded = plan.build(pubs, msgs, sigs)
-        rows = self._backend(view, plan, *padded)
-        real, bad_shards = plan.extract(rows)
-        if self.metrics is not None:
-            self.metrics.tiles.inc()
-        if not bad_shards:
+            if not bad_shards:
+                if self.metrics is not None:
+                    self.metrics.lanes.inc(len(pubs), backend="mesh")
+                shards = [view.shard_ids[plan.shard_of(i)]
+                          for i in range(len(pubs))]
+                return real, shards
+            # one or more shards answered canary/pad rows wrong: mask
+            # each (mesh re-factors smaller), and THIS batch
+            # re-verifies on the trusted CPU path — no shard verdict
+            # from a batch containing a lying chip is ever surfaced
+            span.event("canary-failure",
+                       shards=[view.shard_ids[s] for s in bad_shards])
+            for s in bad_shards:
+                self.supervisor.report_shard_corruption(
+                    view.shard_ids[s],
+                    f"canary/pad rows wrong "
+                    f"(view {view.shape[0]}x{view.shape[1]})")
             if self.metrics is not None:
-                self.metrics.lanes.inc(len(pubs), backend="mesh")
-            shards = [view.shard_ids[plan.shard_of(i)]
-                      for i in range(len(pubs))]
-            return real, shards
-        # one or more shards answered canary/pad rows wrong: mask each
-        # (mesh re-factors smaller), and THIS batch re-verifies on the
-        # trusted CPU path — no shard verdict from a batch containing
-        # a lying chip is ever surfaced
-        for s in bad_shards:
-            self.supervisor.report_shard_corruption(
-                view.shard_ids[s],
-                f"canary/pad rows wrong "
-                f"(view {view.shape[0]}x{view.shape[1]})")
-        if self.metrics is not None:
-            self.metrics.lanes.inc(len(pubs), backend="cpu")
-        return _native_verify(pubs, msgs, sigs), [CPU_SHARD] * len(pubs)
+                self.metrics.lanes.inc(len(pubs), backend="cpu")
+            with tracer.start("mesh.cpu_reverify", parent=span,
+                              reason="canary-failure"):
+                out = _native_verify(pubs, msgs, sigs)
+            return out, [CPU_SHARD] * len(pubs)
 
     def _maybe_probe(self) -> None:
         """Run EVERY due regrow probe this turn: probe_due() claims

@@ -95,6 +95,12 @@ class ShardSupervisor:
             self._strikes[shard_id] = strikes
             window = self._window_s(strikes)
             self._next_probe_at[shard_id] = self._clock() + window
+        # flight-recorder dump: the causal chain that led a shard to
+        # lie is exactly what the quarantine post-mortem needs; keyed
+        # per (shard, strike) so each distinct corruption event dumps
+        # once even when several batches hit the same sick shard
+        from ..trace import trigger_dump
+        trigger_dump("shard-quarantine", f"{shard_id}:{strikes}", detail)
         try:
             view = self.topology.mask(shard_id)
         except MeshShapeError:

@@ -243,6 +243,14 @@ class Node:
         from ..libs.metrics_gen import MeshMetrics
         self.mesh_metrics = MeshMetrics(self.metrics_registry)
         _mesh.configure(config.device)
+        # flight-recorder tracing ([instrumentation] trace —
+        # docs/TRACE.md): same first-node-wins latch as the device
+        # supervisor; COMETBFT_TPU_TRACE* env knobs override
+        from .. import trace as _trace
+        from ..libs.metrics_gen import TraceMetrics
+        self.trace_metrics = TraceMetrics(self.metrics_registry)
+        _trace.configure(config.instrumentation,
+                         metrics=self.trace_metrics)
         # the process-wide verified-signature cache (vote intake, light
         # client, blocksync) reports hit/miss/eviction through the same
         # struct. First node wins: with several nodes in one process
@@ -415,6 +423,17 @@ class Node:
             # flusher first: relayed/async txs must settle even before
             # any RPC waiter performs a cooperative flush
             self.ingest.start()
+        from .. import mesh as _mesh
+        if _mesh.mesh_enabled():
+            # warm the shared mesh executor off the boot path: the
+            # first build compiles the bucket ladder (minutes on real
+            # hardware) and the farm/ingest batchers route through the
+            # mesh whenever no device server is configured — a cold
+            # build inside a live flush would stall every submitter
+            threading.Thread(
+                target=lambda: _mesh.shared_executor(
+                    metrics=self.mesh_metrics),
+                name="mesh-warm", daemon=True).start()
         if self.rpc_server is not None:
             self.rpc_server.start()          # RPC first (node.go:559)
         if self.grpc_services is not None:
@@ -491,7 +510,7 @@ class Node:
         commit, and return the State for blocksync to continue from.
         Returns None when nothing usable was found (boot falls back to
         blocksync-from-genesis)."""
-        import time as _time
+        from ..libs import timesource
         from ..statesync.stateprovider import light_provider_from_config
         from ..statesync.syncer import Syncer, StateSyncError
         from ..statesync.reactor import net_snapshot_sources
@@ -499,11 +518,13 @@ class Node:
         ss = self.config.statesync
         provider = light_provider_from_config(ss, self.genesis)
 
-        # deliberately wall clock: waits on REAL peer snapshot offers
-        # during statesync discovery (simnet does not drive statesync)
-        deadline = _time.monotonic() + ss.discovery_time_ms / 1000.0  # staticcheck: allow(wallclock)
+        # discovery waits read the timesource seam: wall clocks on a
+        # live node, and under a simnet virtual source the deadline
+        # math follows the simulated clock (timesource.sleep degrades
+        # to a real yield so the sim thread that advances time runs)
+        deadline = timesource.monotonic() + ss.discovery_time_ms / 1000.0
         state = None
-        while _time.monotonic() < deadline:  # staticcheck: allow(wallclock)
+        while timesource.monotonic() < deadline:
             sources = net_snapshot_sources(self.statesync_reactor)
             if sources:
                 try:
@@ -514,7 +535,7 @@ class Node:
                     # snapshots may be too close to the tip for the
                     # height+2 anchor; the chain advances — retry
                     pass
-            _time.sleep(0.5)
+            timesource.sleep(0.5)
         if state is None:
             return None
         # persist the bootstrap (reference node.go:152 BootstrapState)

@@ -41,6 +41,7 @@ trusted un-canaried.
 
 from __future__ import annotations
 
+import inspect
 import queue
 import threading
 from collections import deque
@@ -56,6 +57,7 @@ from ..engine.blocksync import (BlocksyncReactor, SyncStalled,
 from ..libs.fail import fail_point
 from ..state.execution import BlockValidationError
 from ..state.state import State
+from ..trace import shared_tracer
 
 
 # --- futures + verify backends ------------------------------------------------
@@ -157,7 +159,7 @@ class DeviceClientBackend:
     def __init__(self, client):
         self._client = client
 
-    def submit(self, pubs, msgs, sigs):
+    def submit(self, pubs, msgs, sigs, ctx=None):
         c = self._client
         if c is None or c._dead is not None:
             # ride the supervisor-gated reconnect: shared_client()
@@ -170,6 +172,12 @@ class DeviceClientBackend:
                 raise ReconnectBlocked(
                     "device link down, no reconnect")
             self._client = c
+        # ctx is an opt-in keyword: a reconnect can hand us any client
+        # implementation (tests inject plain-signature stubs), so only
+        # forward trace context to clients that declare it
+        if ctx is not None and "ctx" in inspect.signature(
+                c.submit).parameters:
+            return self._Adapter(c.submit(pubs, msgs, sigs, ctx=ctx))
         return self._Adapter(c.submit(pubs, msgs, sigs))
 
     def close(self) -> None:
@@ -297,6 +305,7 @@ class _Tile:
     out: Optional[np.ndarray] = None
     valset_break: bool = False       # a header announced a new valset
     n_canaries: int = 0              # canary lanes appended at dispatch
+    span: object = None              # trace span: build..settle lifetime
 
     @property
     def n_lanes(self) -> int:
@@ -334,6 +343,11 @@ class PipelinedBlocksync:
         if isinstance(cap, int) and cap > 0:
             depth = min(depth, cap)
         self.depth = depth
+        # ctx propagation is opt-in per backend (mesh + device client
+        # backends take ctx=; the LocalAsyncBackend and injected test
+        # backends keep their plain 3-arg submit) — decided once here
+        self._backend_takes_ctx = (
+            "ctx" in inspect.signature(self.backend.submit).parameters)
         self.watchdog = watchdog
         self.metrics = metrics
         self.supervisor = supervisor  # device/health.DeviceSupervisor
@@ -350,13 +364,27 @@ class PipelinedBlocksync:
     def _build_tile(self, start: int, target: int, spec_vals) -> _Tile:
         """fetch + marshal + dispatch for one tile (raises SyncStalled
         when the source cannot serve the range)."""
+        tracer = shared_tracer()
+        tspan = tracer.start("pipeline.tile", start=start)
+        try:
+            return self._build_tile_traced(start, target, spec_vals,
+                                           tracer, tspan)
+        except BaseException:
+            tspan.set_attr("outcome", "error")
+            tspan.end()
+            raise
+
+    def _build_tile_traced(self, start, target, spec_vals, tracer,
+                           tspan) -> _Tile:
         self._occupy("fetch", 1)
         try:
-            fetched, end = self.r._fetch_range(start, target)
+            with tracer.start("pipeline.fetch", parent=tspan):
+                fetched, end = self.r._fetch_range(start, target)
         finally:
             self._occupy("fetch", 0)
 
         self._occupy("marshal", 1)
+        marshal_span = tracer.start("pipeline.marshal", parent=tspan)
         try:
             spec_hash = spec_vals.hash()
             entries: List[TileEntry] = []
@@ -379,11 +407,14 @@ class PipelinedBlocksync:
                                     msgs, sigs, self.r.cache)
                      for e in entries]
         finally:
+            marshal_span.end()
             self._occupy("marshal", 0)
 
         tile = _Tile(start=start, end=end, fetched=fetched,
                      entries=entries, metas=metas, pubs=pubs, msgs=msgs,
-                     sigs=sigs, valset_break=valset_break)
+                     sigs=sigs, valset_break=valset_break, span=tspan)
+        tspan.set_attr("end", end)
+        tspan.set_attr("lanes", len(pubs))
         if not pubs:
             tile.out = np.zeros((0,), dtype=bool)  # all cached/absent
         elif self._device_blocked():
@@ -391,7 +422,9 @@ class PipelinedBlocksync:
             # don't even dispatch — drain this tile straight to the CPU
             if self.watchdog is not None:
                 self.watchdog._fallback()
-            tile.out = self._cpu_verify(pubs, msgs, sigs)
+            with tracer.start("pipeline.cpu_drain", parent=tspan,
+                              reason="device-blocked"):
+                tile.out = self._cpu_verify(pubs, msgs, sigs)
         else:
             d_pubs, d_msgs, d_sigs = pubs, msgs, sigs
             if self.supervisor is not None and self.supervisor.canary:
@@ -402,7 +435,12 @@ class PipelinedBlocksync:
                 tile.n_canaries = health.CANARY_LANES
             fail_point("pipeline:dispatch")
             try:
-                tile.future = self.backend.submit(d_pubs, d_msgs, d_sigs)
+                if self._backend_takes_ctx:
+                    tile.future = self.backend.submit(
+                        d_pubs, d_msgs, d_sigs, ctx=tspan)
+                else:
+                    tile.future = self.backend.submit(
+                        d_pubs, d_msgs, d_sigs)
             except Exception as e:  # noqa: BLE001 — a dead device link
                 # at submit degrades exactly like a deadline miss;
                 # ReconnectBlocked was already accounted inside
@@ -415,7 +453,9 @@ class PipelinedBlocksync:
                     self.watchdog._fallback()
                 elif self.supervisor is not None and not accounted:
                     self.supervisor.report_trip(e)
-                tile.out = self._cpu_verify(pubs, msgs, sigs)
+                with tracer.start("pipeline.cpu_drain", parent=tspan,
+                                  reason="submit-error"):
+                    tile.out = self._cpu_verify(pubs, msgs, sigs)
                 return tile
             if self.metrics is not None:
                 self.metrics.tiles_dispatched.inc()
@@ -469,28 +509,40 @@ class PipelinedBlocksync:
         """Resolve the tile's verdicts (waiting on the dispatch under
         the watchdog deadline; CPU fallback on wedge) and map them onto
         entry.commit_ok."""
-        if tile.out is None:
-            total = tile.n_lanes + tile.n_canaries
-            if self.watchdog is not None:
-                out = self.watchdog.result(tile.future, total)
-                if out is None:  # wedged: drain this tile to the CPU
-                    self._cancel(tile)
-                    out = self._cpu_verify(tile.pubs, tile.msgs,
-                                           tile.sigs)
+        tracer = shared_tracer()
+        sspan = tracer.start("pipeline.settle", parent=tile.span)
+        try:
+            if tile.out is None:
+                total = tile.n_lanes + tile.n_canaries
+                if self.watchdog is not None:
+                    out = self.watchdog.result(tile.future, total)
+                    if out is None:  # wedged: drain tile to the CPU
+                        self._cancel(tile)
+                        with tracer.start("pipeline.cpu_drain",
+                                          parent=sspan,
+                                          reason="watchdog-wedge"):
+                            out = self._cpu_verify(
+                                tile.pubs, tile.msgs, tile.sigs)
+                    else:
+                        out = self._canary_check(tile, out, sspan)
                 else:
-                    out = self._canary_check(tile, out)
-            else:
-                out = self._canary_check(tile, tile.future.result())
-            tile.out = np.asarray(out, dtype=bool)
-        settle_tile(tile.metas, tile.out, tile.pubs, tile.msgs,
-                    tile.sigs, self.r.cache)
-        if tile.entries:
-            self.r.stats.tiles_flushed += 1
-            self.r.stats.sigs_verified += sum(
-                1 for e in tile.entries for cs in e.commit.signatures
-                if not cs.absent_())
+                    out = self._canary_check(tile, tile.future.result(),
+                                             sspan)
+                tile.out = np.asarray(out, dtype=bool)
+            settle_tile(tile.metas, tile.out, tile.pubs, tile.msgs,
+                        tile.sigs, self.r.cache)
+            if tile.entries:
+                self.r.stats.tiles_flushed += 1
+                self.r.stats.sigs_verified += sum(
+                    1 for e in tile.entries for cs in e.commit.signatures
+                    if not cs.absent_())
+        finally:
+            sspan.end()
+            if tile.span is not None:
+                tile.span.end()
+                tile.span = None
 
-    def _canary_check(self, tile: _Tile, out):
+    def _canary_check(self, tile: _Tile, out, sspan=None):
         """Strip + verify this tile's canary lanes. A mismatch means
         the device returned corrupt VERDICTS (not a transport failure):
         quarantine it and re-verify the whole batch on CPU — a device
@@ -503,12 +555,16 @@ class PipelinedBlocksync:
             if self.supervisor is not None:
                 self.supervisor.report_success()
             return stripped
+        if sspan is not None:
+            sspan.event("canary-failure", tile=tile.start)
         if self.supervisor is not None:
             self.supervisor.report_corruption(
                 f"tile {tile.start}..{tile.end} canary mismatch")
         if self.watchdog is not None:
             self.watchdog._fallback()  # count the drain like a wedge
-        return self._cpu_verify(tile.pubs, tile.msgs, tile.sigs)
+        with shared_tracer().start("pipeline.cpu_drain", parent=sspan,
+                                   reason="canary-failure"):
+            return self._cpu_verify(tile.pubs, tile.msgs, tile.sigs)
 
     def _occupy(self, stage: str, n: int) -> None:
         if self.metrics is not None:

@@ -89,6 +89,12 @@ class DeviceWatchdog:
     def _trip(self, exc: BaseException) -> None:
         self.trips += 1
         self.last_error = exc
+        # flight-recorder dump keyed per distinct trip: the ring at
+        # this moment holds the dispatch/settle spans leading into the
+        # wedge (trace/ is a no-op while tracing is disabled)
+        from ..trace import trigger_dump
+        trigger_dump("watchdog-trip", str(self.trips),
+                     f"{type(exc).__name__}: {exc}")
         if self.supervisor is not None:
             self.supervisor.report_trip(exc)
         else:

@@ -20,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
 
 from ..pubsub.query import Query, QueryError
+from ..trace import shared_tracer
 from ..types.block import tx_hash
 
 
@@ -295,17 +296,24 @@ class Routes:
         ing = self.env.ingest
         if ing is not None:
             from ..ingest import IngestShed
-            try:
-                ticket = ing.submit(raw)
-            except IngestShed as e:
-                raise RPCError(-32005, f"ingest overloaded: {e}")
-            except ValueError as e:
-                raise RPCError(-32603, str(e)) from e
-            ing.wait([ticket])
-            if ticket.error is not None:
-                raise RPCError(-32603, str(ticket.error))
-            return {"code": ticket.code,
-                    "hash": tx_hash(raw).hex().upper()}
+            # trace root for the whole admission chain: rpc root ->
+            # ingest.admit (child, rides the ticket) -> the coalesced
+            # flush links back here — the causal chain the flight
+            # recorder reconstructs after a shed/quarantine event
+            with shared_tracer().start("rpc.broadcast_tx",
+                                       route="sync") as span:
+                try:
+                    ticket = ing.submit(raw, ctx=span)
+                except IngestShed as e:
+                    raise RPCError(-32005, f"ingest overloaded: {e}")
+                except ValueError as e:
+                    raise RPCError(-32603, str(e)) from e
+                ing.wait([ticket])
+                if ticket.error is not None:
+                    raise RPCError(-32603, str(ticket.error))
+                span.set_attr("code", ticket.code)
+                return {"code": ticket.code,
+                        "hash": tx_hash(raw).hex().upper()}
         try:
             code = self.env.mempool.check_tx(raw)
         except ValueError as e:
@@ -323,12 +331,14 @@ class Routes:
         if ing is not None:
             # fire-and-forget through the batch path: the waiter's
             # cooperative flush (or the background flusher) settles it
-            ticket = ing.submit_nowait(raw)
-            if ticket is not None:
-                try:
-                    ing.wait([ticket])
-                except RuntimeError:
-                    pass
+            with shared_tracer().start("rpc.broadcast_tx",
+                                       route="async") as span:
+                ticket = ing.submit_nowait(raw, ctx=span)
+                if ticket is not None:
+                    try:
+                        ing.wait([ticket])
+                    except RuntimeError:
+                        pass
             return
         try:
             self.env.mempool.check_tx(raw)

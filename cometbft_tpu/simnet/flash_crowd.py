@@ -39,13 +39,16 @@ Invariant probes:
 from __future__ import annotations
 
 import hashlib
+import os
 import time as _walltime
 from typing import Dict, List, Tuple
 
 import random
 
+from .. import trace as _trace
 from ..ingest import CODE_BAD_SIGNATURE, IngestPipeline, IngestShed
 from ..ingest.tx import MAGIC, sign_bytes, unwrap_payload
+from ..libs import timesource
 from ..mempool.mempool import CListMempool, tx_key
 from ..pipeline.cache import SigCache
 from .harness import SimResult
@@ -74,9 +77,11 @@ def _signed(pub: bytes, payload: bytes, good: bool = True) -> bytes:
 
 
 class _CrowdSim:
-    def __init__(self, scenario, seed: int, quick: bool):
+    def __init__(self, scenario, seed: int, quick: bool, workdir=None):
         self.name = scenario.name
         self.seed = seed
+        self.workdir = workdir
+        self._vclock_ns = 0
         if quick:
             self.n_clients, self.rounds = 200, 2
         else:
@@ -121,8 +126,29 @@ class _CrowdSim:
 
     # --- run ---------------------------------------------------------------
 
+    def _vclock(self) -> int:
+        """Counter clock for the trace seam: each observation advances
+        one virtual millisecond, so span timestamps — and therefore the
+        trace JSONL — are a pure function of (scenario, seed)."""
+        self._vclock_ns += 1_000_000
+        return self._vclock_ns
+
     def run(self) -> SimResult:
         t0 = _walltime.perf_counter()  # staticcheck: allow(wallclock)
+        # the crowd sim runs no nodes, so no harness virtual clock is
+        # installed — tracing still demands deterministic timestamps
+        own_clock = not timesource.installed()
+        if own_clock:
+            timesource.install(self._vclock)
+        _tracer, recorder = _trace.enable(seed=self.seed)
+        try:
+            return self._run_traced(t0, recorder)
+        finally:
+            _trace.disable()
+            if own_clock:
+                timesource.reset()
+
+    def _run_traced(self, t0: float, recorder) -> SimResult:
         self.mempool = CListMempool(self._check_fn,
                                     size=4 * self.n_clients,
                                     cache_size=8 * self.n_clients)
@@ -140,6 +166,9 @@ class _CrowdSim:
             self._check_mempool_agreement(r)
         self._final_checks()
         st = self.pipe.stats()
+        tr = recorder.stats()
+        self.log("trace", spans=tr["recorded"], evicted=tr["evicted"],
+                 dumps=len(recorder.dumps))
         self.log("end", admitted=self.admitted, rejected=self.rejected,
                  shed=self.shed, dups=self.dups,
                  batches=st["batches"],
@@ -152,6 +181,15 @@ class _CrowdSim:
         for line in self.log_lines:
             digest.update(line.encode())
             digest.update(b"\n")
+        # the flight-recorder ring is part of the determinism contract:
+        # its JSONL rides the same digest the per-seed test pins
+        trace_jsonl = recorder.snapshot_jsonl()
+        digest.update(trace_jsonl.encode())
+        if self.workdir:
+            with open(os.path.join(self.workdir,
+                                   f"trace_seed{self.seed}.jsonl"),
+                      "w") as f:
+                f.write(trace_jsonl)
         return SimResult(
             scenario=self.name, seed=self.seed,
             violations=self.violations,
@@ -335,7 +373,6 @@ class _CrowdSim:
 
 def run_flash_crowd(scenario, seed: int, quick: bool = False,
                     workdir=None) -> SimResult:
-    """Scenario runner (scenarios.py dispatches here; `workdir` is part
-    of the runner contract but unused — the crowd sim touches no
-    files)."""
-    return _CrowdSim(scenario, seed, quick).run()
+    """Scenario runner (scenarios.py dispatches here; `workdir`, when
+    set, receives the run's flight-recorder JSONL)."""
+    return _CrowdSim(scenario, seed, quick, workdir=workdir).run()

@@ -40,10 +40,13 @@ Invariant probes:
 from __future__ import annotations
 
 import hashlib
+import os
 import random
 import time as _walltime
 from typing import List
 
+from .. import trace as _trace
+from ..libs import timesource
 from ..mesh import MeshExecutor, MeshTopology
 from ..mesh.executor import _native_verify as _native
 from ..mesh.shard_health import ShardSupervisor
@@ -72,9 +75,11 @@ class _CorruptibleMesh:
 
 
 class _MeshSim:
-    def __init__(self, scenario, seed: int, quick: bool):
+    def __init__(self, scenario, seed: int, quick: bool, workdir=None):
         self.name = scenario.name
         self.seed = seed
+        self.workdir = workdir
+        self._vclock_ns = 0
         if quick:
             self.n_blocks, self.n_vals, self.tile = 12, 4, 2
         else:
@@ -151,8 +156,27 @@ class _MeshSim:
 
     # --- phases -----------------------------------------------------------
 
+    def _vclock(self) -> int:
+        """Counter clock for the trace seam (one virtual millisecond
+        per observation): span timestamps, and thus the trace JSONL
+        the digest pins, are a pure function of (scenario, seed)."""
+        self._vclock_ns += 1_000_000
+        return self._vclock_ns
+
     def run(self) -> SimResult:
         t0 = _walltime.perf_counter()  # staticcheck: allow(wallclock)
+        own_clock = not timesource.installed()
+        if own_clock:
+            timesource.install(self._vclock)
+        _tracer, recorder = _trace.enable(seed=self.seed)
+        try:
+            return self._run_traced(t0, recorder)
+        finally:
+            _trace.disable()
+            if own_clock:
+                timesource.reset()
+
+    def _run_traced(self, t0: float, recorder) -> SimResult:
         from ..engine.chain_gen import generate_chain
         self.build()
         self.log("start", scenario=self.name, seed=self.seed,
@@ -202,6 +226,9 @@ class _MeshSim:
         if self.sup.quarantines != quarantines_before:
             self.violation("healthy mesh tripped a canary post-regrow")
 
+        tr = recorder.stats()
+        self.log("trace", spans=tr["recorded"], evicted=tr["evicted"],
+                 dumps=len(recorder.dumps))
         self.log("end", dispatches=self.stub.dispatches,
                  probes=self.probe_count,
                  quarantines=self.sup.quarantines,
@@ -213,6 +240,14 @@ class _MeshSim:
         for line in self.log_lines:
             digest.update(line.encode())
             digest.update(b"\n")
+        # the flight-recorder ring rides the pinned per-seed digest
+        trace_jsonl = recorder.snapshot_jsonl()
+        digest.update(trace_jsonl.encode())
+        if self.workdir:
+            with open(os.path.join(self.workdir,
+                                   f"trace_seed{self.seed}.jsonl"),
+                      "w") as f:
+                f.write(trace_jsonl)
         return SimResult(
             scenario=self.name, seed=self.seed,
             violations=self.violations, max_height=self.n_blocks,
@@ -300,6 +335,6 @@ class _ClockedBackend:
 
 def run_mesh_degrade(scenario, seed: int, quick: bool = False,
                      workdir=None) -> SimResult:
-    """Scenario runner (scenarios.py dispatches here; `workdir` is
-    part of the runner contract but unused — no files touched)."""
-    return _MeshSim(scenario, seed, quick).run()
+    """Scenario runner (scenarios.py dispatches here; `workdir`, when
+    set, receives the run's flight-recorder JSONL)."""
+    return _MeshSim(scenario, seed, quick, workdir=workdir).run()

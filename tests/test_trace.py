@@ -1,0 +1,377 @@
+"""trace/ — span flight recorder (docs/TRACE.md).
+
+The load-bearing contracts: disabled tracing allocates NOTHING (the
+no-op singleton), span streams are byte-identical per seed, the ring
+evicts oldest with counted drops, every trigger event dumps exactly
+once, and a real verdict-safety event (mesh shard quarantine) yields
+spans that reconstruct the full causal chain — rpc -> ingest ticket ->
+batch flush -> shard dispatch -> CPU re-verify.
+"""
+
+import random
+
+import pytest
+
+from cometbft_tpu import trace
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.ingest import IngestPipeline, make_signed_tx
+from cometbft_tpu.libs import timesource
+from cometbft_tpu.mempool.mempool import CListMempool
+from cometbft_tpu.pipeline.cache import SigCache
+from cometbft_tpu.trace import (NOOP_SPAN, FlightRecorder, Tracer,
+                                causal_chain, load_jsonl)
+
+KEYS = [Ed25519PrivKey.generate(random.Random(2000 + i))
+        for i in range(3)]
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolation():
+    trace.disable()
+    trace.shared_recorder().reset()
+    yield
+    trace.disable()
+    trace.shared_recorder().reset()
+
+
+@pytest.fixture()
+def _vclock():
+    """Deterministic counter clock: one virtual ms per observation."""
+    tick = [0]
+
+    def clock():
+        tick[0] += 1_000_000
+        return tick[0]
+
+    timesource.install(clock)
+    yield clock
+    timesource.reset()
+
+
+# --- no-op mode ---------------------------------------------------------------
+
+
+def test_disabled_tracer_returns_noop_singleton():
+    t = trace.shared_tracer()
+    assert t.enabled is False
+    s1 = t.start("anything", parent=None, lanes=3)
+    s2 = t.start("other")
+    # object IDENTITY, not equality: zero spans allocated when off
+    assert s1 is NOOP_SPAN and s2 is NOOP_SPAN
+    # the no-op span absorbs the full span surface
+    s1.set_attr("k", 1)
+    s1.event("e", x=2)
+    s1.link(None)
+    s1.end()
+    with t.start("ctx-managed") as s3:
+        assert s3 is NOOP_SPAN
+    assert NOOP_SPAN.ctx is None
+
+
+def test_disabled_trigger_dump_is_inert():
+    assert trace.trigger_dump("watchdog-trip", "k") is False
+    assert trace.shared_recorder().dumps == []
+
+
+# --- seeded ids + determinism -------------------------------------------------
+
+
+def test_span_ids_are_seeded(_vclock):
+    rec = FlightRecorder(capacity=16)
+    tr = Tracer(recorder=rec, enabled=True)
+    tr.reseed(9)
+    root = tr.start("a")
+    child = tr.start("b", parent=root)
+    assert root.span_id == 9 * trace.span.SEED_ID_STRIDE + 1
+    assert child.span_id == root.span_id + 1
+    assert child.trace_id == root.trace_id == root.span_id
+    assert child.parent_id == root.span_id
+
+
+def test_identical_streams_are_byte_identical(_vclock):
+    def run():
+        rec = FlightRecorder(capacity=16)
+        tr = Tracer(recorder=rec, enabled=True)
+        tr.reseed(4)
+        with tr.start("outer", lanes=2) as outer:
+            with tr.start("inner", parent=outer) as inner:
+                inner.event("mark", i=1)
+        return rec.snapshot_jsonl()
+
+    timesource.reset()
+    a_tick = [0]
+    timesource.install(lambda: (a_tick.__setitem__(0, a_tick[0] + 10**6)
+                                or a_tick[0]))
+    a = run()
+    b_tick = [0]
+    timesource.install(lambda: (b_tick.__setitem__(0, b_tick[0] + 10**6)
+                                or b_tick[0]))
+    b = run()
+    assert a == b and "inner" in a
+
+
+# --- the ring -----------------------------------------------------------------
+
+
+def test_ring_evicts_oldest_with_counted_drops(_vclock):
+    rec = FlightRecorder(capacity=3)
+    tr = Tracer(recorder=rec, enabled=True)
+    tr.reseed(1)
+    for i in range(10):
+        tr.start(f"s{i}").end()
+    st = rec.stats()
+    assert st["recorded"] == 10
+    assert st["evicted"] == 7
+    assert st["occupancy"] == 3
+    # the survivors are the NEWEST three, oldest first
+    assert [d["name"] for d in rec.snapshot()] == ["s7", "s8", "s9"]
+
+
+def test_ring_metrics_accounting(_vclock):
+    from cometbft_tpu.libs.metrics import Registry
+    from cometbft_tpu.libs.metrics_gen import TraceMetrics
+    m = TraceMetrics(Registry())
+    rec = FlightRecorder(capacity=2, metrics=m)
+    tr = Tracer(recorder=rec, enabled=True)
+    for i in range(5):
+        tr.start(f"s{i}").end()
+    rec.trigger("shed-burst", "k")
+    assert m.spans.value() == 5
+    assert m.dropped.value() == 3
+    assert m.ring_occupancy.value() == 2
+    assert m.dumps.value(kind="shed-burst") == 1
+
+
+# --- exactly-once dumps -------------------------------------------------------
+
+
+def test_trigger_dumps_exactly_once_per_event(_vclock):
+    tr, rec = trace.enable(seed=2)
+    tr.start("before").end()
+    assert trace.trigger_dump("watchdog-trip", "1", "boom") is True
+    # same (kind, key): deduplicated no matter how many call sites fire
+    assert trace.trigger_dump("watchdog-trip", "1", "boom") is False
+    assert trace.trigger_dump("watchdog-trip", "1") is False
+    # a DIFFERENT key is a distinct underlying event
+    assert trace.trigger_dump("watchdog-trip", "2") is True
+    assert trace.trigger_dump("shard-quarantine", "1") is True
+    assert len(rec.dumps) == 3
+    kind, key, detail, text, path = rec.dumps[0]
+    assert (kind, key, detail) == ("watchdog-trip", "1", "boom")
+    assert path is None  # no dump_dir: in-memory only
+    meta, spans = load_jsonl(text)
+    assert meta["kind"] == "watchdog-trip" and meta["seq"] == 0
+    assert [s["name"] for s in spans] == ["before"]
+
+
+def test_dump_writes_file_when_dir_set(tmp_path, _vclock):
+    rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    tr = Tracer(recorder=rec, enabled=True)
+    tr.start("x").end()
+    assert rec.trigger("canary-failure", "node", "bad verdicts")
+    _kind, _key, _detail, text, path = rec.dumps[0]
+    assert path is not None
+    with open(path, encoding="utf-8") as fh:
+        assert fh.read() == text
+
+
+# --- wire trailer -------------------------------------------------------------
+
+
+def test_request_trailer_roundtrip():
+    from cometbft_tpu.device.protocol import (decode_request,
+                                              decode_request_trace,
+                                              encode_request)
+    pubs, msgs, sigs = [b"\0" * 32], [b"m"], [b"\1" * 64]
+    plain = encode_request(7, pubs, msgs, sigs)
+    traced = encode_request(7, pubs, msgs, sigs, trace=trace.TraceContext(
+        0xDEAD, 0xBEEF).to_wire())
+    # v1 requests carry no trailer — byte-compatible with old servers
+    assert decode_request_trace(plain) is None
+    assert decode_request(plain) == decode_request(traced)
+    assert decode_request_trace(traced) == (0xDEAD, 0xBEEF)
+    # any other tail length is a framing error, not silently ignored
+    with pytest.raises(ValueError):
+        decode_request(traced + b"x")
+
+
+# --- the causal chain (acceptance: quarantine dump explains the event) --------
+
+
+def _mesh_under_test(corrupt: bool):
+    """A 2-shard in-process mesh; `corrupt` makes EVERY shard answer
+    all-True (verdict corruption — the canary rows expose it)."""
+    from cometbft_tpu.mesh import MeshExecutor, MeshTopology
+    from cometbft_tpu.mesh.executor import _native_verify as _native
+    from cometbft_tpu.mesh.shard_health import ShardSupervisor
+    topo = MeshTopology(devices=[0, 1])
+    sup = ShardSupervisor(topo, backoff_base_s=0.25, backoff_cap_s=1.0,
+                          clock=lambda: 0.0)
+
+    def backend(view, plan, pubs, msgs, sigs):
+        if corrupt:
+            return [True] * len(pubs)
+        return _native(pubs, msgs, sigs)
+
+    return MeshExecutor(topo, supervisor=sup, verify_backend=backend,
+                        threaded=False)
+
+
+def _mesh_ingest_backend(ex):
+    def backend(lanes, ctx=None):
+        oks = ex.submit([ln.pub for ln in lanes],
+                        [ln.msg for ln in lanes],
+                        [ln.sig for ln in lanes], ctx=ctx).result(0)
+        return [bool(v) for v in oks], "mesh"
+    return backend
+
+
+def _drive_rpc_quarantine(seed: int) -> str:
+    """One traced run: rpc broadcast -> ingest batch -> corrupt mesh ->
+    shard quarantine + CPU re-verify. Returns the ring JSONL."""
+    from cometbft_tpu.ingest import CODE_BAD_SIGNATURE
+    from cometbft_tpu.ingest.tx import MAGIC
+    from cometbft_tpu.rpc.server import RPCEnvironment, Routes
+    trace.enable(seed=seed)
+    try:
+        ex = _mesh_under_test(corrupt=True)
+        mp = CListMempool(lambda tx: (0, 1))
+        pipe = IngestPipeline(mp, cache=SigCache(256), batch=True,
+                              coalesce_window_s=0.0,
+                              verify_backend=_mesh_ingest_backend(ex))
+        routes = Routes(RPCEnvironment(chain_id="trace-test",
+                                       mempool=mp, ingest=pipe))
+        bad = bytearray(make_signed_tx(KEYS[0], b"k=1"))
+        bad[len(MAGIC) + 32] ^= 0x01
+        r = routes.broadcast_tx_sync(bytes(bad).hex())
+        # containment: the corrupt all-True mesh must NOT admit the
+        # tampered tx — the canary trip re-verified it on CPU
+        assert r["code"] == CODE_BAD_SIGNATURE
+        assert mp.size() == 0
+        rec = trace.shared_recorder()
+        assert any(k == "shard-quarantine" for k, *_ in rec.dumps)
+        return rec.snapshot_jsonl()
+    finally:
+        trace.disable()
+        trace.shared_recorder().reset()
+
+
+def test_quarantine_dump_reconstructs_causal_chain(_vclock):
+    jsonl = _drive_rpc_quarantine(seed=5)
+    _meta, spans = load_jsonl(jsonl)
+    reverifies = [s for s in spans if s["name"] == "mesh.cpu_reverify"]
+    assert len(reverifies) == 1
+    chain = causal_chain(spans, reverifies[0]["sid"])
+    assert [s["name"] for s in chain] == [
+        "rpc.broadcast_tx", "ingest.admit", "ingest.flush",
+        "ingest.verify", "mesh.dispatch", "mesh.cpu_reverify"]
+    # the dispatch span carries the canary-failure event
+    dispatch = chain[-2]
+    assert any(name == "canary-failure" for _t, name, _a
+               in dispatch.get("ev", ()))
+
+
+def test_quarantine_trace_is_byte_identical_per_seed():
+    runs = []
+    for _ in range(2):
+        tick = [0]
+        timesource.install(
+            lambda: (tick.__setitem__(0, tick[0] + 10**6) or tick[0]))
+        try:
+            runs.append(_drive_rpc_quarantine(seed=11))
+        finally:
+            timesource.reset()
+    assert runs[0] == runs[1]
+    assert "mesh.cpu_reverify" in runs[0]
+
+
+# --- simnet scenarios emit deterministic trace JSONL --------------------------
+
+
+def test_flash_crowd_trace_file_deterministic(tmp_path):
+    from cometbft_tpu.simnet.flash_crowd import run_flash_crowd
+
+    class Sc:
+        name = "flash-crowd"
+
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    r1 = run_flash_crowd(Sc, 7, quick=True, workdir=str(d1))
+    r2 = run_flash_crowd(Sc, 7, quick=True, workdir=str(d2))
+    assert r1.violations == [] and r1.digest == r2.digest
+    t1 = (d1 / "trace_seed7.jsonl").read_bytes()
+    t2 = (d2 / "trace_seed7.jsonl").read_bytes()
+    assert t1 == t2 and t1.count(b"\n") > 0
+    # the shed bursts the scenario forces must have dumped
+    assert any(line.startswith("trace ") and "dumps=0" not in line
+               for line in r1.log_lines)
+
+
+def test_mesh_degrade_trace_file_deterministic(tmp_path):
+    from cometbft_tpu.simnet.mesh_degrade import run_mesh_degrade
+
+    class Sc:
+        name = "mesh-degrade"
+
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    r1 = run_mesh_degrade(Sc, 3, quick=True, workdir=str(d1))
+    r2 = run_mesh_degrade(Sc, 3, quick=True, workdir=str(d2))
+    assert r1.violations == [] and r1.digest == r2.digest
+    t1 = (d1 / "trace_seed3.jsonl").read_bytes()
+    assert t1 == (d2 / "trace_seed3.jsonl").read_bytes()
+    assert b"mesh.dispatch" in t1
+
+
+# --- satellite: farm/ingest route through the shared mesh ---------------------
+
+
+def _ed25519_lanes(n=6):
+    from cometbft_tpu.ingest.batcher import SigLane
+    cache = SigCache(256)
+    lanes = []
+    for i in range(n):
+        k = KEYS[i % len(KEYS)]
+        msg = f"lane{i}".encode()
+        sig = k.sign(msg)
+        if i == 2:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]  # one tampered lane
+        pub = k.pub_key().bytes_()
+        lanes.append(SigLane(pub, msg, sig, cache.key(pub, msg, sig)))
+    return lanes
+
+
+def test_backend_routes_through_shared_mesh(monkeypatch):
+    """With no device server and a serving mesh, device_or_cpu_backend
+    must dispatch through the shared MeshExecutor with verdicts equal
+    to the CPU reference path, attributed backend=mesh."""
+    from cometbft_tpu import mesh as mesh_mod
+    from cometbft_tpu.farm.batcher import device_or_cpu_backend
+    from cometbft_tpu.ingest.batcher import IngestBatcher, native_backend
+    ex = _mesh_under_test(corrupt=False)
+    monkeypatch.setattr(mesh_mod, "mesh_enabled", lambda: True)
+    monkeypatch.setattr(mesh_mod, "shared_executor",
+                        lambda metrics=None, log=None: ex)
+    lanes = _ed25519_lanes()
+    want, _ = native_backend(lanes)
+    got, backend = device_or_cpu_backend(lanes)
+    assert backend == "mesh"
+    assert got == want and want[2] is False and want[0] is True
+    # the ingest batcher's default backend takes the same route and
+    # attributes the lanes to the mesh
+    b = IngestBatcher(SigCache(256))
+    verdicts = b.verify(lanes)
+    assert b.lanes_by_backend == {"mesh": len(lanes)}
+    assert [verdicts[ln.key] for ln in lanes] == want
+
+
+def test_backend_falls_through_when_mesh_absent(monkeypatch):
+    """mesh off -> the pre-existing kernel/native ladder, unchanged."""
+    from cometbft_tpu import mesh as mesh_mod
+    from cometbft_tpu.farm.batcher import device_or_cpu_backend
+    from cometbft_tpu.ingest.batcher import native_backend
+    monkeypatch.setattr(mesh_mod, "mesh_enabled", lambda: False)
+    lanes = _ed25519_lanes(4)
+    want, _ = native_backend(lanes)
+    got, backend = device_or_cpu_backend(lanes)
+    assert got == want and backend == "cpu"

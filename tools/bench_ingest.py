@@ -22,7 +22,7 @@ a production node exports.
 
 Usage:
     python tools/bench_ingest.py [--clients 256] [--rounds 6]
-        [--latency 0.002] [--json]
+        [--latency 0.002] [--trace] [--json]
 """
 
 from __future__ import annotations
@@ -77,7 +77,13 @@ def _mk_pipeline(backend, cap=1 << 16):
     return pipe, metrics
 
 
-def run(clients: int, rounds: int, latency_s: float) -> dict:
+def run(clients: int, rounds: int, latency_s: float,
+        trace: bool = False) -> dict:
+    from cometbft_tpu import trace as _trace
+    if trace:
+        _trace.enable(seed=0)
+    else:
+        _trace.disable()
     n = clients * rounds
     print(f"[bench_ingest] generating {n} MAC-signed txs...",
           file=sys.stderr, flush=True)
@@ -140,6 +146,8 @@ def run(clients: int, rounds: int, latency_s: float) -> dict:
         "burst_offered": offered,
         "burst_shed": int(shed),
         "shed_rate": round(shed / offered, 3),
+        "trace": trace,
+        "trace_spans": int(_trace.shared_recorder().stats()["recorded"]),
     }
 
 
@@ -150,9 +158,13 @@ def main(argv=None) -> int:
     ap.add_argument("--rounds", type=int, default=6)
     ap.add_argument("--latency", type=float, default=0.002,
                     help="stub device round-trip seconds per dispatch")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable the flight recorder for the timed run "
+                         "(measures tracing-on overhead; default measures "
+                         "the disabled no-op path)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
-    rep = run(args.clients, args.rounds, args.latency)
+    rep = run(args.clients, args.rounds, args.latency, trace=args.trace)
     print(f"[bench_ingest] batched {rep['value']} tx/s vs sequential "
           f"{rep['sequential_tx_s']} tx/s -> "
           f"{rep['speedup_vs_sequential']}x; p90 admission "

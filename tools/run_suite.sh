@@ -75,6 +75,15 @@ echo "=== bls-valset quick sweep + aggsig A/B smoke ===" >&2
 python tools/sim_run.py --scenario bls-valset --seeds 0..2 --quick || rc=$?
 BENCH_AGG_VALS=20 BENCH_AGG_BLOCKS=2 BENCH_AGG_SAMPLE=2 \
     python bench.py --aggsig || rc=$?
+# flight recorder (trace/): the viewer's invariant selftest (export /
+# causal-chain / chrome conversion), then a trace-determinism sweep —
+# the traced scenarios must emit byte-identical span streams per seed
+# (docs/TRACE.md; the full contract suite is tests/test_trace.py in
+# suite 2/2, these two re-pin the acceptance surface cheaply)
+echo "=== trace_view selftest + trace-determinism sweep ===" >&2
+python tools/trace_view.py --selftest || rc=$?
+python -m pytest tests/test_trace.py -q \
+    -k "deterministic or byte_identical" || rc=$?
 # suite 2/2 already covers the slow-marked pipeline soak on a default
 # (unfiltered) run; this explicit step guarantees the depth sweep even
 # when the caller filtered the main suites (e.g. -m 'not slow'), so no

@@ -51,7 +51,10 @@ def _summary(r) -> str:
 
 
 def _run_single(args) -> int:
-    r = run_scenario(args.scenario, args.seed, quick=args.quick)
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+    r = run_scenario(args.scenario, args.seed, quick=args.quick,
+                     workdir=args.out)
     for line in r.log_lines:
         print(line)
     print(_summary(r))
@@ -140,6 +143,10 @@ def main(argv=None) -> int:
                     help="scenario name, or 'all' (sweep round-robin)")
     ap.add_argument("--quick", action="store_true",
                     help="reduced target heights (CI smoke)")
+    ap.add_argument("--out", default=None,
+                    help="workdir for run artifacts (single-run mode): "
+                         "node dirs, and for traced scenarios the "
+                         "trace_seed<N>.jsonl flight-recorder stream")
     ap.add_argument("--selftest", action="store_true")
     ap.add_argument("--list", action="store_true")
     args = ap.parse_args(argv)

@@ -219,11 +219,13 @@ class ReactorSleepRule(Rule):
     # mesh/: the dispatch loop serializes every tile; a sleep there
     # stalls K-per-shard pipelining, and the shard supervisor's probe
     # windows flow through timesource for the mesh-degrade scenario's
-    # determinism
+    # determinism. trace/: the recorder runs inline under data-plane
+    # locks (span end -> record), so a sleep there stalls every
+    # instrumented hot path at once
     roots = ("cometbft_tpu/consensus", "cometbft_tpu/pipeline",
              "cometbft_tpu/engine", "cometbft_tpu/farm",
              "cometbft_tpu/ingest", "cometbft_tpu/aggsig",
-             "cometbft_tpu/mesh")
+             "cometbft_tpu/mesh", "cometbft_tpu/trace")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
@@ -324,16 +326,19 @@ class BareExceptRule(Rule):
     watchdog and supervisor key off — name the exceptions."""
     name = "bare-except"
     doc = ("bare `except:` in device/, pipeline/, farm/, ingest/, "
-           "aggsig/, or mesh/ — catch named exception types so "
+           "aggsig/, mesh/, or trace/ — catch named exception types so "
            "wedge/corruption signals propagate")
     # farm/ and ingest/ dispatch through the same device seam: a
     # swallowed canary/transport signal would hide corruption from the
     # supervisor; aggsig/'s FinalExpChecker rides the same canary/
     # quarantine discipline; mesh/'s per-shard canary checks and
-    # probe errors are exactly the signals shard quarantine keys off
+    # probe errors are exactly the signals shard quarantine keys off;
+    # trace/ sits inline in all of the above — a bare except in the
+    # recorder could eat the very exception a dump is documenting
     roots = ("cometbft_tpu/device", "cometbft_tpu/pipeline",
              "cometbft_tpu/farm", "cometbft_tpu/ingest",
-             "cometbft_tpu/aggsig", "cometbft_tpu/mesh")
+             "cometbft_tpu/aggsig", "cometbft_tpu/mesh",
+             "cometbft_tpu/trace")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):

@@ -1,0 +1,176 @@
+"""Flight-recorder trace viewer — JSONL dumps -> Chrome trace-event JSON.
+
+Usage:
+  python tools/trace_view.py dump.jsonl [-o trace.json]
+      Convert a flight-recorder dump (or a ring snapshot) to the Chrome
+      trace-event format; load the output at chrome://tracing or
+      ui.perfetto.dev. `-o -` (the default) writes to stdout.
+
+  python tools/trace_view.py dump.jsonl --chain <sid>
+      Reconstruct and print the causal chain ending at span id <sid>
+      (cause first): parent links walked span by span, coalescing seams
+      (a flush span serving many tickets) crossed via span links.
+
+  python tools/trace_view.py --selftest
+      Build a synthetic rpc -> ingest -> flush -> mesh trace through
+      the REAL Tracer/FlightRecorder under a virtual clock, trigger a
+      dump, convert it, and assert the invariants the test suite and
+      acceptance checks rely on (id determinism, parent/link fidelity,
+      exactly-once dumps, stable double conversion). Exit 0 on success;
+      wired into tools/run_suite.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cometbft_tpu.libs import timesource  # noqa: E402
+from cometbft_tpu.trace.export import (causal_chain, convert,  # noqa: E402
+                                       load_jsonl)
+from cometbft_tpu.trace.recorder import FlightRecorder  # noqa: E402
+from cometbft_tpu.trace.span import NOOP_SPAN, Tracer  # noqa: E402
+
+
+def _convert_file(path: str, out: str) -> int:
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    doc = convert(text)
+    if out in ("-", ""):
+        sys.stdout.write(doc + "\n")
+    else:
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(doc + "\n")
+        print(f"wrote {out}", file=sys.stderr)
+    return 0
+
+
+def _print_chain(path: str, sid: int) -> int:
+    with open(path, "r", encoding="utf-8") as fh:
+        _meta, spans = load_jsonl(fh.read())
+    chain = causal_chain(spans, sid)
+    if not chain:
+        print(f"no span with sid={sid} in {path}", file=sys.stderr)
+        return 1
+    for i, span in enumerate(chain):
+        hop = "  " * i
+        attrs = span.get("attrs", {})
+        extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        print(f"{hop}{span['name']} sid={span['sid']} "
+              f"tid={span['tid']} t0={span['t0']} t1={span['t1']}"
+              + (f" {extra}" if extra else ""))
+    return 0
+
+
+def _selftest() -> int:
+    # virtual clock so the selftest's bytes are reproducible anywhere
+    vclock = [1_000_000]
+
+    def now_ns() -> int:
+        vclock[0] += 1_000
+        return vclock[0]
+
+    timesource.install(now_ns)
+    try:
+        rec = FlightRecorder(capacity=64)
+        tracer = Tracer(recorder=rec, enabled=True, seed=7)
+
+        # disabled mode returns the singleton — no allocations
+        tracer.enabled = False
+        assert tracer.start("off") is NOOP_SPAN
+        tracer.enabled = True
+
+        # rpc root -> ingest admit; a flush span links the admit span
+        root = tracer.start("rpc.broadcast_tx", route="sync")
+        admit = tracer.start("ingest.admit", parent=root, lane=0)
+        admit.event("enqueued", depth=1)
+        admit.end()
+        flush = tracer.start("ingest.flush", lanes=1)
+        flush.link(admit.ctx)
+        mesh = tracer.start("mesh.dispatch", parent=flush, shards=2)
+        cpu = tracer.start("mesh.cpu_reverify", parent=mesh, shard=1)
+        cpu.end()
+        mesh.end()
+        flush.end()
+        root.end()
+
+        # seeded ids are deterministic
+        assert root.span_id == 7 * (1 << 20) + 1, root.span_id
+        assert admit.parent_id == root.span_id
+
+        # exactly-once dump per (kind, key)
+        assert rec.trigger("selftest", "0", "forced") is True
+        assert rec.trigger("selftest", "0", "forced") is False
+        assert len(rec.dumps) == 1
+
+        kind, key, _detail, text, _path = rec.dumps[0]
+        assert (kind, key) == ("selftest", "0")
+        meta, spans = load_jsonl(text)
+        assert meta is not None and meta["kind"] == "selftest"
+        assert meta["spans"] == len(spans) == 5
+        assert meta["evicted"] == 0
+
+        # causal chain crosses the flush coalescing seam back to rpc
+        chain = causal_chain(spans, cpu.span_id)
+        names = [s["name"] for s in chain]
+        assert names == ["rpc.broadcast_tx", "ingest.admit",
+                         "ingest.flush", "mesh.dispatch",
+                         "mesh.cpu_reverify"], names
+
+        # conversion round-trips and is stable
+        doc1 = convert(text)
+        doc2 = convert(text)
+        assert doc1 == doc2
+        events = json.loads(doc1)["traceEvents"]
+        complete = [e for e in events if e["ph"] == "X"]
+        instants = [e for e in events if e["ph"] == "i"]
+        assert len(complete) == 5 and len(instants) == 1
+        by_name = {e["name"]: e for e in complete}
+        assert (by_name["ingest.admit"]["args"]["parent_sid"]
+                == root.span_id)
+        assert (by_name["ingest.flush"]["args"]["links"]
+                == [admit.span_id])
+        assert all(e["dur"] >= 0 for e in complete)
+
+        # ring eviction accounting survives overflow
+        small = FlightRecorder(capacity=2)
+        t2 = Tracer(recorder=small, enabled=True, seed=1)
+        for i in range(5):
+            t2.start(f"s{i}").end()
+        st = small.stats()
+        assert st["recorded"] == 5 and st["evicted"] == 3
+        assert st["occupancy"] == 2
+    finally:
+        timesource.reset()
+    print("trace_view selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="flight-recorder JSONL -> Chrome trace JSON")
+    ap.add_argument("input", nargs="?", help="dump/snapshot JSONL file")
+    ap.add_argument("-o", "--output", default="-",
+                    help="output path ('-' = stdout)")
+    ap.add_argument("--chain", type=int, metavar="SID",
+                    help="print the causal chain ending at span SID")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in invariant checks")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest()
+    if not args.input:
+        ap.error("input JSONL required (or --selftest)")
+    if args.chain is not None:
+        return _print_chain(args.input, args.chain)
+    return _convert_file(args.input, args.output)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
