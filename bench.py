@@ -360,8 +360,53 @@ def _pipeline_mode() -> int:
     return 0
 
 
-def _aggsig_mode() -> int:
-    """`bench.py --aggsig`: 200-validator blocksync catch-up A/B —
+def _aggsig_mode(miller_backend: str = "fast") -> int:
+    """`bench.py --aggsig [--miller-backend oracle|fast|kernel]`:
+    pick the Miller-loop implementation for the BLS legs, restore
+    process state afterwards, and ALWAYS emit the one JSON line —
+    a kernel failure degrades to the CPU path inside the
+    supervisor-attached PairingChecker (probe/backoff discipline,
+    device/health), and even a setup crash still prints an error
+    record so sweep harnesses never lose the datapoint.
+
+      oracle — the slow per-pair r-loop Miller product (pre-PR
+               baseline, kept as the correctness oracle);
+      fast   — the host optimal-ate loop (default production path);
+      kernel — the fused ops/bls12 Miller + final-exp device call
+               (COMETBFT_TPU_AGGSIG_KERNEL=1 semantics; on XLA:CPU
+               this pays the multi-minute scan compile the ledger
+               attributes under bls-miller@bucket|platform)."""
+    import cometbft_tpu.crypto.bls12381 as bls_mod
+    from cometbft_tpu.aggsig.verify import (ENV_KERNEL,
+                                            reset_shared_finalexp)
+    if miller_backend not in ("oracle", "fast", "kernel"):
+        _log(f"unknown --miller-backend {miller_backend!r} "
+             "(expected oracle|fast|kernel)")
+        return 2
+    restore = (bls_mod.miller_product, bls_mod.miller_loop)
+    if miller_backend == "oracle":
+        bls_mod.miller_product = bls_mod.miller_product_slow
+        bls_mod.miller_loop = bls_mod.miller_loop_slow
+    elif miller_backend == "kernel":
+        os.environ[ENV_KERNEL] = "1"
+    reset_shared_finalexp()     # re-decide the backend under the knob
+    try:
+        return _aggsig_bench(miller_backend)
+    except Exception as exc:  # noqa: BLE001 — the JSON line must land
+        print(json.dumps({"metric": "aggsig_catchup_commit_verify",
+                          "miller_backend": miller_backend,
+                          "error": f"{type(exc).__name__}: {exc}"}),
+              flush=True)
+        return 1
+    finally:
+        bls_mod.miller_product, bls_mod.miller_loop = restore
+        if miller_backend == "kernel":
+            os.environ.pop(ENV_KERNEL, None)
+        reset_shared_finalexp()
+
+
+def _aggsig_bench(miller_backend: str) -> int:
+    """200-validator blocksync catch-up A/B —
     ed25519 batch verification vs the BLS aggregate-commit fast path
     (ROADMAP item 2, docs/AGGSIG.md).
 
@@ -391,7 +436,7 @@ def _aggsig_mode() -> int:
     from cometbft_tpu.abci.kvstore import KVStoreApplication
     from cometbft_tpu.aggsig.aggregate import (register_pops_batch,
                                                reset_pop_registry)
-    from cometbft_tpu.aggsig.verify import shared_finalexp
+    from cometbft_tpu.aggsig.verify import shared_pairing
     from cometbft_tpu.crypto.bls12381 import OP_COUNTERS
     from cometbft_tpu.db.kv import MemDB
     from cometbft_tpu.engine.blocksync import BlocksyncReactor
@@ -403,6 +448,18 @@ def _aggsig_mode() -> int:
     from cometbft_tpu.state.state import State, StateStore
     from cometbft_tpu.store.blockstore import BlockStore
     from cometbft_tpu.types.agg_commit import AggregatedCommit
+
+    pc = shared_pairing()
+    if pc.backend == "kernel" and pc.supervisor is None:
+        # probe/backoff supervision for the device path: a tripping or
+        # corrupt kernel degrades every checker to CPU and the trip is
+        # visible in the emitted record instead of killing the bench
+        from cometbft_tpu.device.health import DeviceSupervisor
+        sup = DeviceSupervisor()
+        pc.supervisor = sup
+        pc.finalexp.supervisor = sup
+    _log(f"miller backend: {miller_backend} "
+         f"(pairing checker backend: {pc.backend})")
 
     def catchup(chain) -> float:
         app = KVStoreApplication()
@@ -483,7 +540,9 @@ def _aggsig_mode() -> int:
         "value": round(agg_commit_s, 3),
         "unit": "s/commit",
         "vs_baseline": round(projected_commit_s / agg_commit_s, 1),
-        "backend": shared_finalexp().backend,
+        "backend": pc.backend,
+        "miller_backend": miller_backend,
+        "kernel_quarantined": pc.quarantined,
         "validators": n_vals,
         "blocks": n_blocks,
         "pairings_per_commit": {
@@ -818,7 +877,11 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--pipeline":
         sys.exit(_pipeline_mode())
     if len(sys.argv) > 1 and sys.argv[1] == "--aggsig":
-        sys.exit(_aggsig_mode())
+        mb = "fast"
+        if "--miller-backend" in sys.argv[2:]:
+            i = sys.argv.index("--miller-backend")
+            mb = sys.argv[i + 1] if i + 1 < len(sys.argv) else ""
+        sys.exit(_aggsig_mode(mb))
     if len(sys.argv) > 1 and sys.argv[1] == "--mesh":
         sys.exit(_mesh_mode())
     sys.exit(main())

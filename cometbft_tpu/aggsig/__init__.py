@@ -12,10 +12,11 @@ Layout:
                  process registry, and the BlsBatchVerifier plugged
                  into crypto/batch's dispatch seam.
   verify.py    — aggregated-commit verification (one pairing equation
-                 per commit), the batched final-exponentiation backend
-                 (ops/bls12 kernel on device platforms, native CPU
-                 fallback, canary-lane gated per the PR-3 discipline),
-                 and the SigCache keying of whole-aggregate verdicts.
+                 per commit), the batched pairing backend (the fused
+                 ops/bls12 Miller + final-exp kernel on device
+                 platforms, native CPU fallback, canary-lane gated per
+                 the PR-3 discipline), and the SigCache keying of
+                 whole-aggregate verdicts.
 
 The AggregatedCommit seal itself lives in types/agg_commit.py (wire
 format beside the other consensus types); docs/AGGSIG.md documents the
@@ -27,4 +28,5 @@ from .aggregate import (  # noqa: F401
     has_pop, pop_prove, pop_verify, register_pop, reset_pop_registry,
     valset_pops_ok)
 from .verify import (  # noqa: F401
-    AggregateVerificationError, shared_finalexp, verify_aggregated_commit)
+    AggregateVerificationError, shared_finalexp, shared_pairing,
+    verify_aggregated_commit)

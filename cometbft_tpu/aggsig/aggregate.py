@@ -145,17 +145,74 @@ def register_pop(pub_bytes: bytes, pop: bytes, metrics=None) -> bool:
     return ok
 
 
+def _kernel_pop_check(pending, metrics=None):
+    """PoP admission through the fused pairing kernel: each key is one
+    2-pair item (e(-g1, s_pop)·e(pk, H(msg)) == 1 — the kernel's
+    native shape), so per-key verdicts are exact with NO
+    random-linear-combination round and no per-failure fallback.
+    Returns (all_ok, registered) or None when the shared checker is
+    not a warm, healthy kernel (cold ledger / quarantine / cpu
+    backend) — genesis and state-reload re-admission always lands on
+    the RLC path because the kernel is never warm at boot."""
+    from ..libs.jax_cache import ledger
+    from ..ops.bls12 import bucket_for
+    from .verify import shared_pairing
+    pc = shared_pairing()
+    if pc.backend != "kernel" or pc.quarantined:
+        return None
+    # +2: the checker splices its canary items into the batch
+    if not ledger().warm_in_process(
+            "bls-miller", bucket_for(len(pending) + 2)):
+        return None
+    items = []
+    lanes: List[bytes] = []
+    all_ok = True
+    for pub, pop in pending:
+        try:
+            pk = bls.Bls12381PubKey(pub)
+            s = (bls.g2_decompress(pop)
+                 if len(pop) == bls.SIGNATURE_LENGTH else None)
+        except ValueError:
+            s = None
+        if s is None:
+            all_ok = False
+            if metrics is not None:
+                metrics.pop_rejections.inc()
+            continue
+        h = bls.hash_to_g2_cached(bls._fixed_msg(_pop_msg(pub)))
+        items.append([(bls.G1_NEG, s), (pk.point, h)])
+        lanes.append(pub)
+    oks = pc.check(items) if items else []
+    with _POP_LOCK:
+        for pub, ok in zip(lanes, oks):
+            if ok:
+                _POP_OK[pub] = True
+    for ok in oks:
+        if not ok:
+            all_ok = False
+            if metrics is not None:
+                metrics.pop_rejections.inc()
+    return all_ok
+
+
 def register_pops_batch(pops: Dict[bytes, bytes], metrics=None) -> bool:
-    """Verify + record many proofs of possession in ONE random-linear-
-    combination multi-pairing (BlsBatchVerifier) — genesis admission of
-    an n-validator BLS set costs ~1 Miller loop per key plus a single
-    shared final exponentiation instead of n full verifies. Per-key
-    verdicts are exact (the batch falls back per-signature on a
-    combined failure); returns True iff every PoP verified."""
+    """Verify + record many proofs of possession in one batch —
+    genesis admission of an n-validator BLS set costs ~1 Miller loop
+    per key plus shared final exponentiation work instead of n full
+    verifies. When the shared PairingChecker is kernel-backed, healthy,
+    and its Miller kernel is ledger-warm for this batch shape, each
+    key rides the fused device call as its own exact 2-pairing lane;
+    otherwise (always at genesis/state-reload boot, where the kernel
+    is cold) the random-linear-combination multi-pairing
+    (BlsBatchVerifier) runs host-side. Per-key verdicts are exact on
+    both routes; returns True iff every PoP verified."""
     pending = [(pub, pop) for pub, pop in pops.items()
                if not has_pop(pub)]
     if not pending:
         return True
+    kernel_out = _kernel_pop_check(pending, metrics=metrics)
+    if kernel_out is not None:
+        return kernel_out
     bv = BlsBatchVerifier()
     lanes: List[bytes] = []
     all_ok = True

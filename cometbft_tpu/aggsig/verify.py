@@ -11,16 +11,20 @@ under a virtual clock, or any co-timed quorum — collapse to a single
 group and the whole commit costs TWO Miller loops and ONE final
 exponentiation, independent of validator-set size).
 
-The final exponentiation — the dominant shared cost — is routed
-through a FinalExpChecker so many commits verify together during
-blocksync: the host computes each commit's Miller product, the checker
-batches the `final_exp(m) == 1` verdicts on the ops/bls12 JAX kernel
-when a device platform is configured, with a native CPU fallback and
-the PR-3 canary discipline (a known-one and a known-not-one element
+The whole pairing check is routed through a PairingChecker so many
+commits verify together during blocksync: the marshal stage stops at
+the (G1, G2) pair lists, and the checker settles the fused
+`final_exp(Π miller(P_i, Q_i)) == 1` verdicts in one ops/bls12 device
+call per tile (the batched optimal-ate Miller scan + in-kernel final
+exponentiation) when a device platform is configured, with a native
+CPU fallback (host optimal-ate Miller product + FinalExpChecker) and
+the PR-3 canary discipline (a known-one and a known-not-one item
 spliced into every kernel batch; any canary mismatch quarantines the
 kernel for the process, re-verifies the batch on CPU, and reports to a
 DeviceSupervisor when one is attached — a wrong kernel verdict can
-never reach commit verification).
+never reach commit verification). The FinalExpChecker below survives
+as the CPU path's final-exponentiation stage and as the settle route
+for items whose pair count exceeds the kernel's fixed shape.
 
 Whole-aggregate verdicts are SigCache-keyed (path="aggsig"): the
 triple (b"aggsig|" + valset-hash, seal-digest, agg_sig) makes a hit
@@ -147,7 +151,124 @@ class FinalExpChecker:
         return [bool(v) for v in verdicts[:-2]]
 
 
+# --- fused pairing checker ----------------------------------------------------
+
+class PairingChecker:
+    """Batched `final_exp(Π miller(P_i, Q_i)) == 1` verdicts over
+    items that are LISTS OF PAIRS (the marshal stage's output —
+    Miller products are no longer computed at marshal time).
+
+    backend="cpu": host optimal-ate miller_product per item, final
+    exponentiations batched through the attached FinalExpChecker.
+    backend="kernel": items whose live pair count fits the kernel's
+    fixed shape (MILLER_PAIRS — the 2-loop commit equation) settle in
+    ONE fused ops/bls12 device call (batched Miller scan + in-kernel
+    final exp), canary-gated exactly like FinalExpChecker: every batch
+    carries a known-one and a known-not-one item; a wrong canary
+    verdict quarantines the kernel permanently for this checker,
+    re-verifies the whole batch on the CPU oracle, and reports
+    corruption to the attached supervisor. Items with more pairs
+    (multi-group commits) take the CPU Miller product but still ride
+    the final-exp checker's backend."""
+
+    def __init__(self, backend: str = "cpu", supervisor=None,
+                 finalexp: Optional[FinalExpChecker] = None):
+        if backend not in ("cpu", "kernel"):
+            raise ValueError(f"unknown pairing backend {backend!r}")
+        self.backend = backend
+        self.supervisor = supervisor
+        self.finalexp = finalexp or FinalExpChecker(backend, supervisor)
+        self.quarantined = False
+        self.canary_failures = 0
+
+    @staticmethod
+    def _live(pairs) -> list:
+        return [(p, q) for p, q in pairs
+                if p is not None and q is not None]
+
+    def _cpu_check(self, items: Sequence) -> List[bool]:
+        """Host Miller products; final exps through the attached
+        checker (which may itself be kernel-backed and canary-gated)."""
+        products = [bls.miller_product(p) for p in items]
+        return self.finalexp.check(products)
+
+    @staticmethod
+    def _cpu_direct(items: Sequence) -> List[bool]:
+        """Pure-CPU re-verify for the canary-failure arc: when the
+        device answered a known-answer wrong, nothing downstream of it
+        is trusted, including the final-exp kernel."""
+        out = [bls.final_exponentiation(bls.miller_product(p))
+               == bls.F12_ONE for p in items]
+        AGG_COUNTERS["aggregates_cpu"] += len(items)
+        if _metrics is not None:
+            _metrics.aggregates_verified.inc(len(items), backend="cpu")
+        return out
+
+    @staticmethod
+    def _canary_items():
+        """(known-one, known-not-one) pair lists in the kernel's own
+        2-pair shape: miller(-g1,Q)·miller(g1,Q) final-exponentiates
+        to exactly 1; e(g1,Q)^2 != 1 (non-degeneracy, odd order r)."""
+        q = bls.G2_GEN
+        return ([(bls.G1_NEG, q), (bls.G1_GEN, q)],
+                [(bls.G1_GEN, q), (bls.G1_GEN, q)])
+
+    def check(self, items: Sequence) -> List[bool]:
+        items = [list(p) for p in items]
+        if not items:
+            return []
+        if self.backend == "kernel" and not self.quarantined:
+            try:
+                return self._kernel_check(items)
+            except Exception as exc:  # noqa: BLE001 — any kernel
+                # failure (import, compile, runtime) degrades to the
+                # native path; the supervisor hears about it so
+                # probe/backoff applies
+                if self.supervisor is not None:
+                    self.supervisor.report_trip(exc)
+                self.quarantined = True
+        return self._cpu_check(items)
+
+    def _kernel_check(self, items: Sequence) -> List[bool]:
+        from ..ops import bls12 as kernel
+        fuse = [i for i, p in enumerate(items)
+                if len(self._live(p)) <= kernel.MILLER_PAIRS]
+        fuse_set = set(fuse)
+        rest = [i for i in range(len(items)) if i not in fuse_set]
+        verdicts = [False] * len(items)
+        if fuse:
+            good, bad = self._canary_items()
+            batch = [items[i] for i in fuse] + [good, bad]
+            out = kernel.miller_finalexp_is_one_batch(batch)
+            if len(out) != len(batch) or not out[-2] or out[-1]:
+                # canary answered wrong (or the lane count drifted):
+                # quarantine and recompute everything on the CPU oracle
+                self.canary_failures += 1
+                self.quarantined = True
+                if self.supervisor is not None:
+                    self.supervisor.report_corruption("bls miller canary")
+                if _metrics is not None:
+                    _metrics.canary_failures.inc()
+                return self._cpu_direct(items)
+            # the kernel path never calls host miller_product, so the
+            # pairings-per-commit evidence is tallied here instead
+            bls.OP_COUNTERS["miller_loops"] += sum(
+                len(self._live(items[i])) for i in fuse)
+            AGG_COUNTERS["aggregates_kernel"] += len(fuse)
+            if _metrics is not None:
+                _metrics.aggregates_verified.inc(len(fuse),
+                                                backend="kernel")
+            for i, v in zip(fuse, out[:len(fuse)]):
+                verdicts[i] = bool(v)
+        if rest:
+            for i, v in zip(rest, self._cpu_check([items[i] for i in
+                                                   rest])):
+                verdicts[i] = bool(v)
+        return verdicts
+
+
 _shared_checker: Optional[FinalExpChecker] = None
+_shared_pairing: Optional[PairingChecker] = None
 _shared_lock = threading.Lock()
 
 
@@ -167,10 +288,23 @@ def shared_finalexp() -> FinalExpChecker:
         return _shared_checker
 
 
+def shared_pairing() -> PairingChecker:
+    """Process-wide pairing checker: same backend decision as
+    shared_finalexp (whose checker it reuses as its final-exp stage,
+    so the counters stay coherent across both paths)."""
+    global _shared_pairing
+    fx = shared_finalexp()
+    with _shared_lock:
+        if _shared_pairing is None:
+            _shared_pairing = PairingChecker(fx.backend, finalexp=fx)
+        return _shared_pairing
+
+
 def reset_shared_finalexp() -> None:
-    global _shared_checker
+    global _shared_checker, _shared_pairing
     with _shared_lock:
         _shared_checker = None
+        _shared_pairing = None
 
 
 # --- commit verification ------------------------------------------------------
@@ -183,8 +317,10 @@ def _count_pairings(n: int) -> None:
 def _prepare(chain_id: str, vals, commit, voting_power_needed: int,
              ignore, count, lookup_by_index: bool, cache):
     """Shared body: returns ("ok", None) on a cache hit, ("fail", exc)
-    on any decided rejection, or ("pend", (miller_product, cache_key))
-    when only the final exponentiation is outstanding."""
+    on any decided rejection, or ("pend", (pairs, cache_key)) when
+    only the pairing equation is outstanding — the (G1, G2) pair list
+    stays unevaluated so settle time can batch whole Miller loops
+    through the fused kernel, not just final exponentiations."""
     try:
         commit.validate_basic()
         covered = commit.covered_indices()
@@ -284,7 +420,7 @@ def _prepare(chain_id: str, vals, commit, voting_power_needed: int,
     for fixed, pk_sum in groups.items():
         pairs.append((pk_sum, bls.hash_to_g2_cached(fixed)))
     _count_pairings(len(pairs))
-    return "pend", (bls.miller_product(pairs), cache_key)
+    return "pend", (pairs, cache_key)
 
 
 def verify_aggregated_commit(chain_id: str, vals, commit,
@@ -305,8 +441,8 @@ def verify_aggregated_commit(chain_id: str, vals, commit,
         raise payload
     if status == "ok":
         return
-    product, cache_key = payload
-    ok = (checker or shared_finalexp()).check([product])[0]
+    pairs, cache_key = payload
+    ok = (checker or shared_pairing()).check([pairs])[0]
     if not ok:
         raise AggregateVerificationError(
             "aggregate signature does not verify against the signer "
@@ -317,10 +453,10 @@ def verify_aggregated_commit(chain_id: str, vals, commit,
 
 class AggSeal:
     """A marshaled aggregate-commit check: either already decided
-    ("ok"/"fail") or pending only its final exponentiation ("pend",
-    payload = (miller_product, cache_key)). The blocksync marshal
-    stage produces these so settle_tile can batch many commits' final
-    exponentiations through one FinalExpChecker call."""
+    ("ok"/"fail") or pending its pairing equation ("pend", payload =
+    (pairs, cache_key)). The blocksync marshal stage produces these so
+    settle_tile can batch many commits' Miller loops + final
+    exponentiations through one PairingChecker call."""
 
     __slots__ = ("status", "payload")
 
@@ -347,14 +483,14 @@ def prepare_full_commit(chain_id: str, vals, commit, needed: int,
 def settle_seals(seals: Sequence[AggSeal], cache=None,
                  checker=None) -> List[bool]:
     """Resolve marshaled seals to verdicts, batching every pending
-    final exponentiation through one checker call; verified-TRUE
-    aggregates feed the cache."""
+    pairing equation (Miller loops AND final exponentiation) through
+    one checker call; verified-TRUE aggregates feed the cache."""
     pend = [i for i, s in enumerate(seals) if s.status == "pend"]
     verdicts = [s.status == "ok" for s in seals]
     if pend:
         with shared_tracer().start("aggsig.settle", seals=len(seals),
                                    pending=len(pend)):
-            oks = (checker or shared_finalexp()).check(
+            oks = (checker or shared_pairing()).check(
                 [seals[i].payload[0] for i in pend])
         for i, ok in zip(pend, oks):
             verdicts[i] = bool(ok)
@@ -366,9 +502,9 @@ def settle_seals(seals: Sequence[AggSeal], cache=None,
 def verify_aggregated_commits_bulk(chain_id: str, items, cache=None,
                                    checker=None) -> List[bool]:
     """Blocksync form: many (vals, commit, voting_power_needed)
-    triples verified with FULL verify_commit semantics and their final
-    exponentiations batched through one checker call. Returns per-item
-    verdicts (True/False), never raises per-item errors."""
+    triples verified with FULL verify_commit semantics and their
+    pairing equations batched through one checker call. Returns
+    per-item verdicts (True/False), never raises per-item errors."""
     seals = [prepare_full_commit(chain_id, vals, commit, needed, cache)
              for vals, commit, needed in items]
     return settle_seals(seals, cache=cache, checker=checker)

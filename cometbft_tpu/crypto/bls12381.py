@@ -52,9 +52,12 @@ reason); consensus ed25519 remains the TPU-accelerated path.
 
 from __future__ import annotations
 
-import functools
+import collections
 import hashlib
+import threading
 from typing import Optional, Tuple
+
+from ..libs.env import env_int
 
 # --- parameters (identities asserted below) -----------------------------------
 
@@ -453,12 +456,14 @@ def _line(f_add, f_sub, f_mul, f_sq, f_inv, a, b, px, py):
 OP_COUNTERS = {"miller_loops": 0, "final_exps": 0}
 
 
-def miller_loop(p_g1, q_g2) -> F12:
+def miller_loop_slow(p_g1, q_g2) -> F12:
     """Miller loop f_{r,Q}(P) over Fq12 with both points embedded.
     Textbook double-and-add over the full group order r — simple,
-    slow, and unambiguous (no twist/frobenius shortcuts to get wrong);
-    the optimal-ate shortcut can replace this once vectors exist to
-    pin it against."""
+    slow, and unambiguous (no twist/frobenius shortcuts to get wrong).
+    Retained as the oracle the optimal-ate fast path (`miller_loop`)
+    is pinned against: both are nondegenerate bilinear pairings after
+    final exponentiation, so their `multi_pairing_is_one` verdicts
+    are identical (they differ by a fixed exponent coprime to r)."""
     if p_g1 is None or q_g2 is None:
         return F12_ONE
     OP_COUNTERS["miller_loops"] += 1
@@ -475,6 +480,121 @@ def miller_loop(p_g1, q_g2) -> F12:
                            px, py)
             f = f12_mul(f, val)
     return f
+
+
+# --- optimal-ate Miller loop (the fast path) ----------------------------------
+# The ate pairing loops over the BLS parameter x (64 bits, 6 set bits)
+# instead of the 255-bit group order r, with the twist point kept in
+# Jacobian coordinates on E'(Fq2) so no step inverts anything — the
+# slow oracle's per-bit Fq12 inversion is what made it the host floor.
+# x is negative: f_{x,Q} = conj(f_{|x|,Q}) up to factors the final
+# exponentiation kills (conj(f)^E = f^{-E} EXACTLY, because
+# (conj(f)·f)^E = f^{(p^6+1)·E} and r | p^6+1).
+
+X_ABS = -X_PARAM
+_X_BITS = bin(X_ABS)[2:]
+MILLER_STEPS = len(_X_BITS) - 1               # 63 doubling steps
+MILLER_ADD_STEPS = _X_BITS[1:].count("1")     # 5 addition steps
+
+
+def f12_conj(a: F12) -> F12:
+    """a ↦ a^(p^6): Frobenius^6 is the identity on the Fq2
+    coefficients and w^(p^6) = w·ξ^((p^6-1)/6) = -w, so conjugation
+    negates the odd-w coefficients (pinned against f12_frobenius
+    applied six times by tests)."""
+    return (a[0], f2_neg(a[1]), a[2], f2_neg(a[3]), a[4], f2_neg(a[5]))
+
+
+def f12_mul_sparse035(a: F12, c0: F2, c3: F2, c5: F2) -> F12:
+    """Multiply by a line value c0 + c3·w^3 + c5·w^5 — the sparse
+    shape every evaluated optimal-ate line takes after untwisting
+    (18 Fq2 products instead of f12_mul's 36; dense-vs-sparse
+    equivalence is test-pinned)."""
+    acc = [F2_ZERO] * 11
+    for i in range(6):
+        ai = a[i]
+        if ai == F2_ZERO:
+            continue
+        acc[i] = f2_add(acc[i], f2_mul(ai, c0))
+        acc[i + 3] = f2_add(acc[i + 3], f2_mul(ai, c3))
+        acc[i + 5] = f2_add(acc[i + 5], f2_mul(ai, c5))
+    for k in range(10, 5, -1):
+        if acc[k] != F2_ZERO:
+            acc[k - 6] = f2_add(acc[k - 6], f2_mul(acc[k], XI))
+    return tuple(acc[:6])
+
+
+def _f2_scale(a: F2, s: int) -> F2:
+    return (a[0] * s % P, a[1] * s % P)
+
+
+def prepare_pair_lines(p_g1, q_g2):
+    """Evaluated line coefficients for f_{|x|,Q}(P): one entry per
+    doubling step, ((c0, c3, c5) doubling line, addition line or None).
+
+    Derivation: the untwist sends (x', y') on the M-twist to
+    (x'/w^2, y'/w^3) on E(Fq12), so a twist-side chord/tangent of
+    slope λ' evaluates at embedded P = (px, py) to
+    py + (λ'x' − y')·ξ^{-1}·w^3 − λ'·px·ξ^{-1}·w^5; scaling by ξ and
+    by the Jacobian denominators (Z3·Z1Z1 for the tangent, Z3 for the
+    chord) clears every inversion. All scalings are Fq2* factors,
+    which the final exponentiation kills ((p^2-1) | (p^12-1)/r).
+    Shared by the host fast path and the ops/bls12 kernel marshal."""
+    px, py = p_g1
+    xq, yq = q_g2
+    X, Y, Z = xq, yq, F2_ONE
+    out = []
+    for bit in _X_BITS[1:]:
+        # tangent at T=(X,Y,Z), line scaled by Z3·Z1Z1 (dbl-2009-l)
+        A = f2_sq(X)
+        B = f2_sq(Y)
+        Z1Z1 = f2_sq(Z)
+        C = f2_sq(B)
+        D = f2_sub(f2_sub(f2_sq(f2_add(X, B)), A), C)
+        D = f2_add(D, D)                          # 4·X·Y^2
+        E = f2_add(f2_add(A, A), A)               # 3·X^2
+        Z3 = f2_mul(f2_add(Y, Y), Z)
+        dbl = (_f2_scale(f2_mul(XI, f2_mul(Z3, Z1Z1)), py),
+               f2_sub(f2_mul(E, X), f2_add(B, B)),
+               _f2_scale(f2_neg(f2_mul(E, Z1Z1)), px))
+        X3 = f2_sub(f2_sq(E), f2_add(D, D))
+        C8 = f2_add(C, C)
+        C8 = f2_add(C8, C8)
+        C8 = f2_add(C8, C8)
+        Y3 = f2_sub(f2_mul(E, f2_sub(D, X3)), C8)
+        X, Y, Z = X3, Y3, Z3
+        add = None
+        if bit == "1":
+            # chord through T and affine Q, anchored at Q, scaled Z3
+            Z1Z1 = f2_sq(Z)
+            U2 = f2_mul(xq, Z1Z1)
+            S2 = f2_mul(yq, f2_mul(Z, Z1Z1))
+            H = f2_sub(U2, X)
+            Rr = f2_sub(S2, Y)
+            Z3 = f2_mul(Z, H)
+            add = (_f2_scale(f2_mul(XI, Z3), py),
+                   f2_sub(f2_mul(Rr, xq), f2_mul(yq, Z3)),
+                   _f2_scale(f2_neg(Rr), px))
+            HH = f2_sq(H)
+            H3 = f2_mul(H, HH)
+            V = f2_mul(X, HH)
+            X3 = f2_sub(f2_sub(f2_sq(Rr), H3), f2_add(V, V))
+            Y3 = f2_sub(f2_mul(Rr, f2_sub(V, X3)), f2_mul(Y, H3))
+            X, Y, Z = X3, Y3, Z3
+        out.append((dbl, add))
+    return out
+
+
+def miller_loop(p_g1, q_g2) -> F12:
+    """Optimal-ate Miller loop f_{x,Q}(P): 63 inversion-free Jacobian
+    doubling steps + 5 additions over |x| = 0xd201000000010000, sparse
+    line multiplications, final conjugation for the negative x.
+    Final-exponentiation-equal to the slow |x|-loop over the generic
+    embedded machinery, and verdict-equivalent to the r-loop oracle
+    (`miller_loop_slow`) — both pinned by tests."""
+    if p_g1 is None or q_g2 is None:
+        return F12_ONE
+    return miller_product([(p_g1, q_g2)])
 
 
 _FINAL_EXP = (P**12 - 1) // R
@@ -533,14 +653,36 @@ def final_exponentiation(f: F12) -> F12:
     return f12_pow(final_exp_easy(f), _HARD_EXP)
 
 
-def miller_product(pairs) -> F12:
-    """Product of Miller loops over (P_g1, Q_g2) pairs — the shared
-    part of a multi-pairing check (one final exponentiation serves all
-    of them)."""
+def miller_product_slow(pairs) -> F12:
+    """Product of slow-oracle (r-loop) Miller loops over (P_g1, Q_g2)
+    pairs. Retained as the oracle bench.py --miller-backend=oracle and
+    the fast-vs-slow verdict tests run against."""
     out = F12_ONE
     for p_g1, q_g2 in pairs:
-        out = f12_mul(out, miller_loop(p_g1, q_g2))
+        out = f12_mul(out, miller_loop_slow(p_g1, q_g2))
     return out
+
+
+def miller_product(pairs) -> F12:
+    """Product of optimal-ate Miller loops over (P_g1, Q_g2) pairs —
+    the shared part of a multi-pairing check (one final exponentiation
+    serves all of them) — with the per-step Fq12 squaring SHARED
+    across pairs: one f12_sq per parameter bit regardless of pair
+    count, which the per-pair slow oracle cannot express."""
+    live = [(p, q) for p, q in pairs if p is not None and q is not None]
+    if not live:
+        return F12_ONE
+    OP_COUNTERS["miller_loops"] += len(live)
+    prepared = [prepare_pair_lines(p, q) for p, q in live]
+    f = F12_ONE
+    for step in range(MILLER_STEPS):
+        f = f12_sq(f)
+        for lines in prepared:
+            dbl, add = lines[step]
+            f = f12_mul_sparse035(f, *dbl)
+            if add is not None:
+                f = f12_mul_sparse035(f, *add)
+    return f12_conj(f)
 
 
 def multi_pairing_is_one(pairs) -> bool:
@@ -676,14 +818,50 @@ def hash_to_g2(msg: bytes):
     raise ValueError("hash_to_g2 failed (probability ~2^-256)")
 
 
-@functools.lru_cache(maxsize=1024)
+# Explicit LRU with a hard cap instead of functools.lru_cache: the
+# memo is keyed by raw sign-bytes, so on a long chain it grows with
+# distinct (height, round) forever — the cap bounds it and the
+# eviction counter makes the pressure observable (mirrors the
+# SigCache's hits/misses/evictions discipline). Cap is env-tunable
+# because a blocksync verifier re-touches at most a few tiles' worth
+# of messages at once.
+H2C_CACHE_CAP = env_int("COMETBFT_TPU_H2C_CACHE_CAP", 1024, minimum=2)
+H2G2_COUNTERS = {"hits": 0, "misses": 0, "evictions": 0}
+_H2C_LOCK = threading.Lock()
+_H2C_CACHE: "collections.OrderedDict[bytes, object]" = \
+    collections.OrderedDict()
+
+
 def hash_to_g2_cached(msg: bytes):
     """Memoized hash_to_g2 over the (immutable) message bytes. The
     same consensus sign-bytes are hashed by the signer, by every
     verifier in the process (simnet runs all nodes in-process), and by
     the aggregate-commit verifier's message grouping — a pure function
-    of msg, so the memo cannot change any verdict."""
-    return hash_to_g2(msg)
+    of msg, so the memo cannot change any verdict. Bounded LRU
+    (H2C_CACHE_CAP entries, evictions counted in H2G2_COUNTERS)."""
+    with _H2C_LOCK:
+        pt = _H2C_CACHE.get(msg)
+        if pt is not None:
+            _H2C_CACHE.move_to_end(msg)
+            H2G2_COUNTERS["hits"] += 1
+            return pt
+    pt = hash_to_g2(msg)        # outside the lock: the map is pure
+    with _H2C_LOCK:
+        H2G2_COUNTERS["misses"] += 1
+        _H2C_CACHE[msg] = pt
+        _H2C_CACHE.move_to_end(msg)
+        while len(_H2C_CACHE) > H2C_CACHE_CAP:
+            _H2C_CACHE.popitem(last=False)
+            H2G2_COUNTERS["evictions"] += 1
+    return pt
+
+
+def reset_hash_to_g2_cache() -> None:
+    """Test hook: drop memoized points and zero the counters."""
+    with _H2C_LOCK:
+        _H2C_CACHE.clear()
+        for k in H2G2_COUNTERS:
+            H2G2_COUNTERS[k] = 0
 
 
 # --- the key type (reference key_bls12381.go surface) -------------------------

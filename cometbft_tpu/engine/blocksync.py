@@ -79,9 +79,10 @@ def marshal_commit(chain_id: str, e: TileEntry, pubs: List[bytes],
     from ..types.agg_commit import AggregatedCommit
     if isinstance(commit, AggregatedCommit):
         # BLS aggregate seal: the whole-commit check is marshaled here
-        # (structure, tally, PoP gate, Miller product — all host work,
-        # exactly this stage's job) and only the final exponentiation
-        # is left for settle_tile, which batches it across the tile
+        # (structure, tally, PoP gate, pair grouping — all host work,
+        # exactly this stage's job) and the pairing equation itself —
+        # Miller loops AND final exponentiation — is left for
+        # settle_tile, which batches it across the tile
         from ..aggsig.verify import prepare_full_commit
         return e, prepare_full_commit(chain_id, vals, commit, needed,
                                       cache=cache), needed
@@ -158,7 +159,7 @@ def settle_tile(metas, out, pubs, msgs, sigs, cache=None) -> None:
     verify_commit semantics (every included signature valid AND for-block
     power > 2/3); newly verified-true lanes feed the cache. Aggregated
     commits arrive as marshaled AggSeals and settle in ONE batched
-    final-exponentiation call for the whole tile."""
+    pairing call (Miller loops + final exp) for the whole tile."""
     from ..aggsig.verify import AggSeal, settle_seals
     agg = [(e, rows) for e, rows, _n in metas
            if isinstance(rows, AggSeal)]

@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..crypto.bls12381 import P as P_INT, _HARD_EXP
+from ..crypto.bls12381 import (P as P_INT, _HARD_EXP, X_ABS, XI, f2_pow,
+                               prepare_pair_lines)
 
 NLIMBS = 24
 LIMB_BITS = 16
@@ -276,5 +277,320 @@ def final_exp_is_one_batch(products) -> List[bool]:
         bucket = bucket_for(len(easied))
         with ledger().compile_guard("bls12-finalexp", bucket):
             verdicts.extend(pow_is_one_batch(easied, HARD_BITS, bucket))
+        i += len(chunk)
+    return verdicts
+
+
+# --- batched optimal-ate Miller products + fused final exp --------------------
+# The Miller loop itself becomes lane-parallel once the line
+# coefficients are precomputed: the bit chain of |x| is FIXED, so the
+# host evaluates each pair's 63 doubling (+5 addition) lines — cheap
+# Fq2 work in crypto/bls12381.prepare_pair_lines — and the kernel runs
+# the identical instruction stream per lane: one shared Fq12 squaring
+# per step plus one sparse (w^0, w^3, w^5) line multiplication per
+# pair. Addition-step slots on zero bits carry the multiplicative
+# identity so there is NO data-dependent control flow (the bits are
+# static python constants, not traced values).
+#
+# The final exponentiation is fused into the same device call: the
+# easy part runs in-kernel (tower inversion via Fermat Fq powers +
+# the p^2-Frobenius, which on this tower is a per-coefficient Fq
+# scalar multiply), then the existing hard-part pow chain — one jit,
+# one canary-gated verdict batch (aggsig/verify.PairingChecker).
+
+MILLER_X_BITS = tuple(int(b) for b in bin(X_ABS)[2:])
+MILLER_STEPS = len(MILLER_X_BITS) - 1
+MILLER_PAIRS = 2            # the commit equation's fixed pair count
+
+# bits of P-2 (Fermat inversion exponent) and the p^2-Frobenius
+# constants γ2_i = ξ^{i(p^2-1)/6}: all six lie in Fq (asserted), so
+# frobenius^2 is coefficient-wise scalar multiplication.
+_P_M2_BITS = tuple(int(b) for b in bin(P_INT - 2)[2:])
+_GAMMA2 = tuple(f2_pow(XI, i * (P_INT * P_INT - 1) // 6)
+                for i in range(6))
+assert all(g[1] == 0 for g in _GAMMA2)
+_GAMMA2_MONT = tuple(limbs_from_int(g[0] * R_INT % P_INT)
+                     for g in _GAMMA2)
+
+
+def f2_sub(a: F2J, b: F2J) -> F2J:
+    return (sub_mod(a[0], b[0]), sub_mod(a[1], b[1]))
+
+
+def f2_neg(a: F2J) -> F2J:
+    z = jnp.zeros_like(a[0])
+    return (sub_mod(z, a[0]), sub_mod(z, a[1]))
+
+
+def f2_mul_many(pairs) -> List[F2J]:
+    """Many independent Fq2 products in ONE stacked Karatsuba — 3
+    Montgomery multiplies regardless of count. XLA compile time for
+    this jaxlib's CPU backend scales with the number of mont_mul
+    instantiations, not their width (docs/PERF.md "known compile
+    hazard"), so the whole easy part is phrased as a handful of these
+    wide calls instead of per-product towers."""
+    a0 = jnp.stack([a[0] for a, _ in pairs], axis=-1)
+    a1 = jnp.stack([a[1] for a, _ in pairs], axis=-1)
+    b0 = jnp.stack([b[0] for _, b in pairs], axis=-1)
+    b1 = jnp.stack([b[1] for _, b in pairs], axis=-1)
+    v0 = mont_mul(a0, b0)
+    v1 = mont_mul(a1, b1)
+    s = mont_mul(add_mod(a0, a1), add_mod(b0, b1))
+    re = sub_mod(v0, v1)
+    im = sub_mod(sub_mod(s, v0), v1)
+    return [(re[..., n], im[..., n]) for n in range(len(pairs))]
+
+
+def fq_pow_bits(m: jnp.ndarray, bits: Tuple[int, ...]) -> jnp.ndarray:
+    """Fq square-and-multiply over a static MSB-first bit string
+    (bits[0] must be 1) — the Fermat-inversion chain."""
+    assert bits[0] == 1
+
+    def body(acc, bit):
+        sq = mont_mul(acc, acc)
+        wm = mont_mul(sq, m)
+        return jnp.where(bit, wm, sq), None
+
+    acc, _ = lax.scan(body, m, jnp.asarray(list(bits[1:]), jnp.int32))
+    return acc
+
+
+def fq_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """a^(P-2) — total (Fermat); 0 maps to 0, nonzero to the inverse
+    (both in the Montgomery domain)."""
+    return fq_pow_bits(a, _P_M2_BITS)
+
+
+def f2_inv(a: F2J) -> F2J:
+    st = jnp.stack([a[0], a[1]], axis=-1)
+    sq = mont_mul(st, st)
+    ni = fq_inv(add_mod(sq[..., 0], sq[..., 1]))
+    z = jnp.zeros_like(a[1])
+    pr = mont_mul(jnp.stack([a[0], sub_mod(z, a[1])], axis=-1),
+                  ni[..., None])
+    return (pr[..., 0], pr[..., 1])
+
+
+# Fq6 tower helpers for the in-kernel inversion: same algorithms as
+# crypto/bls12381._f6_mul/_f6_inv/f12_inv, but every round of Fq2
+# products rides one f2_mul_many (compile-time discipline above).
+
+def _f6_assemble(prods) -> tuple:
+    z = (jnp.zeros_like(prods[0][0]), jnp.zeros_like(prods[0][0]))
+    c = [z] * 5
+    n = 0
+    for i in range(3):
+        for j in range(3):
+            c[i + j] = f2_add(c[i + j], prods[n])
+            n += 1
+    return (f2_add(c[0], f2_mul_xi(c[3])),
+            f2_add(c[1], f2_mul_xi(c[4])),
+            c[2])
+
+
+def _f6_mul2(a, b, c, d):
+    """(a·b, c·d) in Fq6 — all 18 Fq2 coefficient products stacked."""
+    prods = f2_mul_many(
+        [(a[i], b[j]) for i in range(3) for j in range(3)]
+        + [(c[i], d[j]) for i in range(3) for j in range(3)])
+    return _f6_assemble(prods[:9]), _f6_assemble(prods[9:])
+
+
+def _f6_mul_v(a):
+    return (f2_mul_xi(a[2]), a[0], a[1])
+
+
+def _f6_inv(a):
+    c0, c1, c2 = a
+    sq0, sq2, sq1, m12, m01, m02 = f2_mul_many(
+        [(c0, c0), (c2, c2), (c1, c1), (c1, c2), (c0, c1), (c0, c2)])
+    A = f2_sub(sq0, f2_mul_xi(m12))
+    B = f2_sub(f2_mul_xi(sq2), m01)
+    C = f2_sub(sq1, m02)
+    t = f2_mul_many([(c0, A), (c1, C), (c2, B)])
+    F = f2_add(t[0], f2_mul_xi(f2_add(t[1], t[2])))
+    fi = f2_inv(F)
+    out = f2_mul_many([(A, fi), (B, fi), (C, fi)])
+    return tuple(out)
+
+
+def f12_inv(a):
+    A = (a[0], a[2], a[4])
+    B = (a[1], a[3], a[5])
+    AA, BB = _f6_mul2(A, A, B, B)
+    den = tuple(f2_sub(x, y) for x, y in zip(AA, _f6_mul_v(BB)))
+    di = _f6_inv(den)
+    iA, iB = _f6_mul2(A, di, tuple(f2_neg(x) for x in B), di)
+    return (iA[0], iB[0], iA[1], iB[1], iA[2], iB[2])
+
+
+def f12_conj(a):
+    """a^(p^6): negate the odd-w coefficients."""
+    return (a[0], f2_neg(a[1]), a[2], f2_neg(a[3]), a[4], f2_neg(a[5]))
+
+
+# (NLIMBS, 12) column layout of the γ2 constants: column 2i+c scales
+# coefficient (i, c), so the whole Frobenius is ONE Montgomery multiply
+_GAMMA2_COLS = np.stack([_GAMMA2_MONT[i]
+                         for i in range(6) for _ in range(2)], axis=-1)
+
+
+def f12_frob2(a):
+    """a^(p^2): coefficient i times γ2_i ∈ Fq."""
+    st = jnp.stack([a[i][c] for i in range(6) for c in range(2)],
+                   axis=-1)
+    g = jnp.asarray(_GAMMA2_COLS).reshape(
+        (NLIMBS,) + (1,) * (st.ndim - 2) + (12,))
+    pr = mont_mul(st, g)
+    return tuple((pr[..., 2 * i], pr[..., 2 * i + 1]) for i in range(6))
+
+
+def final_exp_easy_j(f):
+    """In-kernel (p^6-1)(p^2+1) easy part: conj·inverse, then one
+    p^2-Frobenius multiply — mirrors crypto/bls12381.final_exp_easy."""
+    m = f12_mul(f12_conj(f), f12_inv(f))
+    return f12_mul(f12_frob2(m), m)
+
+
+# sparse line multiplication: an evaluated optimal-ate line is
+# c0 + c3·w^3 + c5·w^5, so only 18 of the 36 Fq2 coefficient products
+# survive — still 3 batched Montgomery multiplies, half as wide.
+_SPARSE_JS = (0, 3, 5)
+_SPARSE_PAIRS = [(i, j) for i in range(6) for j in _SPARSE_JS]
+
+
+def f12_mul_sparse(x, line):
+    """x · (c0 + c3·w^3 + c5·w^5) with line = (c0, c3, c5)."""
+    by_j = {0: line[0], 3: line[1], 5: line[2]}
+    a0 = jnp.stack([x[i][0] for i, _ in _SPARSE_PAIRS], axis=-1)
+    a1 = jnp.stack([x[i][1] for i, _ in _SPARSE_PAIRS], axis=-1)
+    b0 = jnp.stack([by_j[j][0] for _, j in _SPARSE_PAIRS], axis=-1)
+    b1 = jnp.stack([by_j[j][1] for _, j in _SPARSE_PAIRS], axis=-1)
+    v0 = mont_mul(a0, b0)
+    v1 = mont_mul(a1, b1)
+    s = mont_mul(add_mod(a0, a1), add_mod(b0, b1))
+    re = sub_mod(v0, v1)
+    im = sub_mod(sub_mod(s, v0), v1)
+    acc = {}
+    for n, (i, j) in enumerate(_SPARSE_PAIRS):
+        k = i + j
+        c = (re[..., n], im[..., n])
+        acc[k] = c if k not in acc else f2_add(acc[k], c)
+    for k in range(10, 5, -1):
+        acc[k - 6] = f2_add(acc[k - 6], f2_mul_xi(acc[k]))
+    return tuple(acc[k] for k in range(6))
+
+
+def _pack_tree(f) -> jnp.ndarray:
+    return jnp.stack([jnp.stack([f[i][0], f[i][1]], axis=0)
+                      for i in range(6)], axis=0)
+
+
+def miller_scan(lines: jnp.ndarray):
+    """Shared-squaring Miller products over precomputed line
+    coefficients, lines shaped (STEPS, MILLER_PAIRS, 2, 3, 2, NLIMBS,
+    B) — axis 2 is doubling/addition phase, axis 3 the (c0, c3, c5)
+    sparse coefficients. Returns conj(f) per lane (the negative-x
+    correction), packed (6, 2, NLIMBS, B)."""
+    width = lines.shape[-1]
+    one = jnp.broadcast_to(
+        jnp.asarray(limbs_from_int(ONE_MONT_INT))[:, None],
+        (NLIMBS, width))
+    zero = jnp.zeros_like(one)
+    f0 = tuple((one, zero) if i == 0 else (zero, zero)
+               for i in range(6))
+
+    def body(arr, step_lines):
+        f = _unpack_tree(arr)
+        f = f12_mul(f, f)                    # ONE squaring, all pairs
+        for pi in range(MILLER_PAIRS):
+            for phase in range(2):           # doubling, then addition
+                ln = tuple((step_lines[pi, phase, c, 0],
+                            step_lines[pi, phase, c, 1])
+                           for c in range(3))
+                f = f12_mul_sparse(f, ln)
+        return _pack_tree(f), None
+
+    arr, _ = lax.scan(body, _pack_tree(f0), lines)
+    return _pack_tree(f12_conj(_unpack_tree(arr)))
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_miller(bucket: int):
+    def run(lines):
+        m = _unpack_tree(miller_scan(lines))
+        easy = final_exp_easy_j(m)
+        return _is_one_mont(pow_bits(easy, HARD_BITS))
+    return jax.jit(run)
+
+
+_IDENTITY_LINE_MONT = None
+
+
+def _identity_line() -> np.ndarray:
+    """(3, 2, NLIMBS) Montgomery limbs of the identity line 1 + 0·w^3
+    + 0·w^5 — the slot filler for zero-bit addition steps, absent
+    pairs, and pad lanes."""
+    global _IDENTITY_LINE_MONT
+    if _IDENTITY_LINE_MONT is None:
+        arr = np.zeros((3, 2, NLIMBS), np.int32)
+        arr[0, 0] = limbs_from_int(ONE_MONT_INT)
+        _IDENTITY_LINE_MONT = arr
+    return _IDENTITY_LINE_MONT
+
+
+def _pack_miller_lines(items, bucket: int) -> np.ndarray:
+    """Evaluate + Montgomery-pack every pair's line coefficients:
+    items is a sequence of ≤MILLER_PAIRS-long pair lists ((P_g1,
+    Q_g2) with None entries skipped); output is (STEPS, MILLER_PAIRS,
+    2, 3, 2, NLIMBS, bucket) int32. Pad lanes carry identity lines
+    throughout, so their Miller product is ONE and their (sliced-off)
+    verdict True — same discipline as pow_is_one_batch."""
+    if len(items) > bucket:
+        raise ValueError(f"batch {len(items)} exceeds bucket {bucket}")
+    out = np.zeros((MILLER_STEPS, MILLER_PAIRS, 2, 3, 2, NLIMBS, bucket),
+                   np.int32)
+    out[:, :, :, 0, 0, :, :] = _identity_line()[0, 0][None, None, None,
+                                                      :, None]
+    for b, pairs in enumerate(items):
+        live = [(p, q) for p, q in pairs
+                if p is not None and q is not None]
+        if len(live) > MILLER_PAIRS:
+            raise ValueError(
+                f"item has {len(live)} pairs > {MILLER_PAIRS}")
+        for pi, (p, q) in enumerate(live):
+            steps = prepare_pair_lines(p, q)
+            for s, (dbl, add) in enumerate(steps):
+                for phase, ln in ((0, dbl), (1, add)):
+                    if ln is None:
+                        continue
+                    for c in range(3):
+                        for comp in range(2):
+                            out[s, pi, phase, c, comp, :, b] = \
+                                limbs_from_int(
+                                    ln[c][comp] * R_INT % P_INT)
+    return out
+
+
+def miller_finalexp_is_one_batch(items) -> List[bool]:
+    """Fused `final_exp(Π miller(P_i, Q_i)) == 1` verdicts, one device
+    call per chunk: host evaluates the line coefficients, the kernel
+    runs the shared-squaring Miller scan, the in-kernel easy part, and
+    the hard-part pow chain. Compiles are recorded in the libs/
+    jax_cache ledger keyed ("bls-miller", bucket). Counted host-side
+    into crypto OP_COUNTERS by the caller (aggsig/verify) so the
+    pairings-per-commit evidence stays backend-independent."""
+    from ..libs.jax_cache import ledger
+    verdicts: List[bool] = []
+    items = list(items)
+    i = 0
+    while i < len(items):
+        chunk = items[i:i + BUCKETS[-1]]
+        bucket = bucket_for(len(chunk))
+        arr = _pack_miller_lines(chunk, bucket)
+        with ledger().compile_guard("bls-miller", bucket):
+            fn = _compiled_miller(bucket)
+            out = np.asarray(fn(jnp.asarray(arr)))
+        verdicts.extend(bool(v) for v in out[:len(chunk)])
         i += len(chunk)
     return verdicts

@@ -678,3 +678,192 @@ def test_ledger_platform_override_keys(tmp_path):
     led.record_crash("rlc-xla", 512, "signal 11", platform="cpu")
     assert led.known_crash("rlc-xla", 512, platform="cpu")
     assert not led.known_crash("rlc-xla", 512, platform="axon")
+
+
+# --- PairingChecker: fused Miller + final-exp verdicts ------------------------
+
+def _pairing_fixtures():
+    """(good, bad, good3) pair-list items: good is the 2-pair commit
+    equation shape, good3 a 3-pair multi-group shape (oversize for the
+    kernel's fixed MILLER_PAIRS)."""
+    h = bls.hash_to_g2(b"\x0b" * 32)
+    s1, s2 = 7, 11
+    good = [(bls.G1_NEG, bls._fq2.pt_mul(s1, h)),
+            (bls._fq.pt_mul(s1, bls.G1_GEN), h)]
+    bad = [(bls.G1_NEG, bls._fq2.pt_mul(s1, h)), (bls.G1_GEN, h)]
+    good3 = [(bls.G1_NEG, bls._fq2.pt_mul(s1 + s2, h)),
+             (bls._fq.pt_mul(s1, bls.G1_GEN), h),
+             (bls._fq.pt_mul(s2, bls.G1_GEN), h)]
+    return good, bad, good3
+
+
+class _HonestMiller:
+    """Stands in for ops.bls12 with host-math verdicts — exercises the
+    PairingChecker/register_pops_batch kernel ARC without paying the
+    real scan compile (the slow test below pins the real kernel)."""
+
+    MILLER_PAIRS = 2
+
+    def __init__(self):
+        self.batches = []
+
+    def miller_finalexp_is_one_batch(self, items):
+        self.batches.append(len(items))
+        return [bls.final_exponentiation(bls.miller_product(p))
+                == bls.F12_ONE for p in items]
+
+
+def test_pairing_checker_cpu_oracle():
+    pc = aggv.PairingChecker("cpu")
+    good, bad, good3 = _pairing_fixtures()
+    assert pc.check([]) == []
+    assert pc.check([good, bad, good3, [(None, None)]]) == \
+        [True, False, True, True]
+    # the shared instance is a singleton riding the shared finalexp
+    assert aggv.shared_pairing() is aggv.shared_pairing()
+    assert aggv.shared_pairing().finalexp is aggv.shared_finalexp()
+
+
+def test_pairing_checker_canary_quarantine(monkeypatch):
+    import cometbft_tpu.ops as ops_pkg
+
+    class _CorruptMiller:
+        MILLER_PAIRS = 2
+
+        @staticmethod
+        def miller_finalexp_is_one_batch(items):
+            return [True] * len(items)
+
+    sup = _Sup()
+    pc = aggv.PairingChecker("kernel", supervisor=sup,
+                             finalexp=aggv.FinalExpChecker("cpu"))
+    monkeypatch.setattr(ops_pkg, "bls12", _CorruptMiller(), raising=False)
+    good, bad, _ = _pairing_fixtures()
+    cpu_before = aggv.AGG_COUNTERS["aggregates_cpu"]
+    out = pc.check([bad, good])
+    # the corrupt kernel answered the known-not-one canary True: the
+    # whole batch re-verifies on the pure-CPU oracle (NOT through the
+    # possibly-corrupt final-exp kernel) and the checker quarantines
+    assert out == [False, True]
+    assert pc.quarantined and pc.canary_failures == 1
+    assert sup.corruptions
+    assert aggv.AGG_COUNTERS["aggregates_cpu"] == cpu_before + 2
+    assert pc.check([bad]) == [False]       # stays on the CPU oracle
+    assert pc.canary_failures == 1
+
+
+def test_pairing_checker_kernel_error_degrades(monkeypatch):
+    import cometbft_tpu.ops as ops_pkg
+
+    class _BoomMiller:
+        MILLER_PAIRS = 2
+
+        @staticmethod
+        def miller_finalexp_is_one_batch(items):
+            raise RuntimeError("miller compile exploded")
+
+    sup = _Sup()
+    pc = aggv.PairingChecker("kernel", supervisor=sup,
+                             finalexp=aggv.FinalExpChecker("cpu"))
+    monkeypatch.setattr(ops_pkg, "bls12", _BoomMiller(), raising=False)
+    good, bad, _ = _pairing_fixtures()
+    assert pc.check([good, bad]) == [True, False]
+    assert pc.quarantined and sup.trips
+
+
+def test_pairing_checker_oversize_item_rides_cpu_miller(monkeypatch):
+    """An item with more live pairs than the kernel's fixed shape
+    (multi-group commit) takes the host Miller product; the 2-pair
+    items still fuse — and the fused batch carries exactly the two
+    canary lanes on top."""
+    import cometbft_tpu.ops as ops_pkg
+    stub = _HonestMiller()
+    pc = aggv.PairingChecker("kernel", supervisor=_Sup(),
+                             finalexp=aggv.FinalExpChecker("cpu"))
+    monkeypatch.setattr(ops_pkg, "bls12", stub, raising=False)
+    good, bad, good3 = _pairing_fixtures()
+    kern_before = aggv.AGG_COUNTERS["aggregates_kernel"]
+    assert pc.check([good, good3, bad]) == [True, True, False]
+    assert stub.batches == [4]              # good + bad + 2 canaries
+    assert not pc.quarantined and pc.canary_failures == 0
+    assert aggv.AGG_COUNTERS["aggregates_kernel"] == kern_before + 2
+
+
+def test_register_pops_batch_kernel_route(tmp_path, monkeypatch):
+    """Ledger-warm kernel backend admits PoPs as exact per-key 2-pair
+    lanes; cold ledger (every genesis/state-reload boot) declines to
+    the RLC host path — the PR-7 re-admission arc keeps working."""
+    import cometbft_tpu.ops as ops_pkg
+    from cometbft_tpu.libs.jax_cache import ledger, reset_ledger
+    from cometbft_tpu.ops import bls12 as real_bls12  # pin sys.modules
+
+    keys = [bls.Bls12381PrivKey.generate(seed=b"pop-kernel-%d" % i)
+            for i in range(3)]
+    pubs = [k.pub_key().bytes_() for k in keys]
+    pops = {pubs[0]: agg.pop_prove(keys[0]),
+            pubs[1]: agg.pop_prove(keys[1]),
+            pubs[2]: agg.pop_prove(keys[0]),   # wrong signer: invalid
+            b"\x05" * 48: b"\x00" * 5}         # malformed pop lane
+    stub = _HonestMiller()
+    monkeypatch.setenv(aggv.ENV_KERNEL, "1")
+    monkeypatch.setattr(ops_pkg, "bls12", stub, raising=False)
+    saved = dict(agg._POP_OK)
+    reset_ledger(os.path.join(tmp_path, "ledger.json"))
+    aggv.reset_shared_finalexp()
+    try:
+        agg.reset_pop_registry()
+        # cold ledger: kernel route declines, RLC path still admits
+        assert agg.register_pops_batch(dict(pops)) is False
+        assert stub.batches == []
+        assert agg.has_pop(pubs[0]) and agg.has_pop(pubs[1])
+        assert not agg.has_pop(pubs[2])
+        agg.reset_pop_registry()
+        bucket = real_bls12.bucket_for(len(pops) + 2)
+        with ledger().compile_guard("bls-miller", bucket):
+            pass                               # mark process-warm
+        kern_before = aggv.AGG_COUNTERS["aggregates_kernel"]
+        assert agg.register_pops_batch(dict(pops)) is False
+        # 3 decompressible lanes + 2 canaries (malformed pop rejected
+        # before the device sees it)
+        assert stub.batches == [5]
+        assert agg.has_pop(pubs[0]) and agg.has_pop(pubs[1])
+        assert not agg.has_pop(pubs[2])
+        assert aggv.AGG_COUNTERS["aggregates_kernel"] == kern_before + 3
+        # idempotent: everything pending already registered or invalid
+        assert agg.register_pops_batch({pubs[0]: pops[pubs[0]]}) is True
+        assert stub.batches == [5]             # nothing re-verified
+    finally:
+        aggv.reset_shared_finalexp()
+        reset_ledger()
+        with agg._POP_LOCK:
+            agg._POP_OK.clear()
+            agg._POP_OK.update(saved)
+
+
+@pytest.mark.slow
+def test_kernel_miller_finalexp_matches_cpu(tmp_path):
+    """The REAL fused kernel (batched Miller scan + in-kernel final
+    exp) against host math, sharing one bucket-4 compile between the
+    raw batch call and a canary-gated PairingChecker."""
+    from cometbft_tpu.libs.jax_cache import ledger, reset_ledger
+    from cometbft_tpu.ops import bls12 as K
+    reset_ledger(os.path.join(tmp_path, "ledger.json"))
+    try:
+        good, bad, _ = _pairing_fixtures()
+        h = bls.hash_to_g2(b"\x0b" * 32)
+        single = [(bls.G1_GEN, h)]             # e(g1, h) != 1
+        empty = [(None, h)]                    # no live pairs -> 1
+        assert K.miller_finalexp_is_one_batch(
+            [good, bad, single, empty]) == [True, False, False, True]
+        sup = _Sup()
+        pc = aggv.PairingChecker("kernel", supervisor=sup,
+                                 finalexp=aggv.FinalExpChecker("cpu"))
+        loops_before = bls.OP_COUNTERS["miller_loops"]
+        assert pc.check([good, bad]) == [True, False]  # 2 + 2 canaries
+        assert not pc.quarantined and pc.canary_failures == 0
+        assert not sup.trips and not sup.corruptions
+        assert bls.OP_COUNTERS["miller_loops"] == loops_before + 4
+        att = ledger().attribution()
+        assert att["misses"] >= 1 and att["hits"] >= 1
+    finally:
+        reset_ledger()

@@ -181,3 +181,106 @@ def test_fast_paths_pinned_against_oracles():
     m = b.miller_loop(b.G1_GEN, b.hash_to_g2(b"\x02" * 32))
     assert b.f12_frobenius(m) == b.f12_pow(m, b.P)
     assert b.final_exponentiation(m) == b.f12_pow(m, b._FINAL_EXP)
+
+
+# --- optimal-ate Miller loop vs the slow oracle (perf-opt PR) -----------------
+
+def test_f12_conj_is_sixth_frobenius():
+    m = b.miller_loop(b.G1_GEN, b.hash_to_g2(b"\x04" * 32))
+    f6 = m
+    for _ in range(6):
+        f6 = b.f12_frobenius(f6)
+    assert b.f12_conj(m) == f6
+
+
+def test_sparse_line_mul_equals_dense():
+    """f12_mul_sparse035 == f12_mul against the embedded sparse
+    element, on a dense operand and on a sparse one."""
+    import random
+    rng = random.Random(17)
+    f2r = lambda: (rng.randrange(b.P), rng.randrange(b.P))
+    dense = tuple(f2r() for _ in range(6))
+    c0, c3, c5 = f2r(), f2r(), f2r()
+    emb = (c0, b.F2_ZERO, b.F2_ZERO, c3, b.F2_ZERO, c5)
+    assert b.f12_mul_sparse035(dense, c0, c3, c5) == b.f12_mul(dense, emb)
+    assert b.f12_mul_sparse035(emb, c0, c3, c5) == b.f12_mul(emb, emb)
+
+
+def test_optimal_ate_full_parameter_pin():
+    """The fast loop against a SLOW |x|-parameter loop built from the
+    generic embedded `_line` machinery (per-step Fq12 inversions, no
+    sparse/Jacobian shortcuts) — the Miller-loop analog of the final-
+    exp full-exponent pin. Equal after final exponentiation: the
+    Jacobian/ξ line scalings are Fq2* factors killed by (p^2-1) | E."""
+    h = b.hash_to_g2(b"\x02" * 32)
+    c = b._fq12
+    px, py = b._embed_g1(b.G1_GEN)
+    q = b._untwist(h)
+    f, t = b.F12_ONE, q
+    for bit in bin(b.X_ABS)[3:]:
+        val, t = b._line(c.add, c.sub, c.mul, c.sq, c.inv, t, t, px, py)
+        f = b.f12_mul(b.f12_sq(f), val)
+        if bit == "1":
+            val, t = b._line(c.add, c.sub, c.mul, c.sq, c.inv, t, q,
+                             px, py)
+            f = b.f12_mul(f, val)
+    f = b.f12_conj(f)                       # negative-x correction
+    assert b.final_exponentiation(f) == \
+        b.final_exponentiation(b.miller_loop(b.G1_GEN, h))
+
+
+def test_optimal_ate_bilinearity_and_slow_verdict_agreement():
+    """e(aP, bQ) == e(P, Q)^{ab} for the fast pairing, and
+    multi_pairing_is_one verdicts agree between the fast product and
+    the slow r-loop oracle on satisfied AND violated equations (the
+    two pairings differ by a fixed exponent coprime to r, so verdicts
+    are identical even though raw values are not)."""
+    h = b.hash_to_g2(b"\x06" * 32)
+    e_base = b.final_exponentiation(b.miller_loop(b.G1_GEN, h))
+    for a_sc, b_sc in ((2, 3), (7, 11)):
+        lhs = b.final_exponentiation(b.miller_loop(
+            b._fq.pt_mul(a_sc, b.G1_GEN), b._fq2.pt_mul(b_sc, h)))
+        assert lhs == b.f12_pow(e_base, a_sc * b_sc)
+    sk = 5
+    good = [(b.G1_NEG, b._fq2.pt_mul(sk, h)),
+            (b._fq.pt_mul(sk, b.G1_GEN), h)]
+    bad = [(b.G1_NEG, b._fq2.pt_mul(sk, h)),
+           (b._fq.pt_mul(sk + 1, b.G1_GEN), h)]
+    for pairs, want in ((good, True), (bad, False)):
+        fast = b.final_exponentiation(
+            b.miller_product(pairs)) == b.F12_ONE
+        slow = b.final_exponentiation(
+            b.miller_product_slow(pairs)) == b.F12_ONE
+        assert fast == slow == want
+    # None pairs are skipped identically
+    assert b.miller_product([(None, h), (b.G1_GEN, None)]) == b.F12_ONE
+    assert b.miller_loop(None, h) == b.F12_ONE
+
+
+def test_miller_op_counters_count_fast_loops():
+    before = b.OP_COUNTERS["miller_loops"]
+    h = b.hash_to_g2(b"\x06" * 32)
+    b.miller_product([(b.G1_NEG, h), (b.G1_GEN, h), (None, h)])
+    assert b.OP_COUNTERS["miller_loops"] == before + 2
+
+
+def test_hash_to_g2_cache_lru_eviction(monkeypatch):
+    """The memo is bounded: the cap evicts least-recently-used entries
+    and the eviction counter makes the pressure observable."""
+    b.reset_hash_to_g2_cache()
+    monkeypatch.setattr(b, "H2C_CACHE_CAP", 2)
+    try:
+        m1, m2, m3 = (bytes([i]) * 40 for i in (1, 2, 3))
+        p1 = b.hash_to_g2_cached(m1)
+        b.hash_to_g2_cached(m2)
+        assert b.hash_to_g2_cached(m1) == p1          # hit, refreshes
+        assert b.H2G2_COUNTERS == {"hits": 1, "misses": 2,
+                                   "evictions": 0}
+        b.hash_to_g2_cached(m3)                       # evicts m2 (LRU)
+        assert b.H2G2_COUNTERS["evictions"] == 1
+        assert b.hash_to_g2_cached(m1) == p1          # still resident
+        assert b.H2G2_COUNTERS["hits"] == 2
+        b.hash_to_g2_cached(m2)                       # re-misses
+        assert b.H2G2_COUNTERS["misses"] == 4
+    finally:
+        b.reset_hash_to_g2_cache()

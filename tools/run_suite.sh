@@ -75,6 +75,12 @@ echo "=== bls-valset quick sweep + aggsig A/B smoke ===" >&2
 python tools/sim_run.py --scenario bls-valset --seeds 0..2 --quick || rc=$?
 BENCH_AGG_VALS=20 BENCH_AGG_BLOCKS=2 BENCH_AGG_SAMPLE=2 \
     python bench.py --aggsig || rc=$?
+# miller kernel smoke: the real fused Miller + final-exp scan against
+# host math plus the canary-gated PairingChecker arc (slow-marked: one
+# bucket-4 scan compile; suite 1/2's unfiltered run covers it too, but
+# this keeps the kernel pinned when the caller filtered with -m)
+echo "=== fused miller kernel smoke (slow; one scan compile) ===" >&2
+python -m pytest tests/test_aggsig.py -q -m slow -k miller || rc=$?
 # flight recorder (trace/): the viewer's invariant selftest (export /
 # causal-chain / chrome conversion), then a trace-determinism sweep —
 # the traced scenarios must emit byte-identical span streams per seed

@@ -15,14 +15,16 @@ SOURCES — expressions whose value is a raw device verdict:
     came from `shared_client()`, a `DeviceClient(...)` constructor, or
     a parameter/attribute annotated `DeviceClient`; `.submit()` on a
     device client returns a `DeviceFuture` via its return annotation);
-  * `ops.bls12.final_exp_is_one_batch(...)` (the FinalExpChecker's
-    kernel feed).
+  * `ops.bls12.final_exp_is_one_batch(...)` and
+    `ops.bls12.miller_finalexp_is_one_batch(...)` (the FinalExpChecker
+    and PairingChecker kernel feeds).
 
 SANITIZERS / GATES — what clears taint:
   * assignment from `device.health.check_canaries(...)` (the verdicts
     come back stripped and length-checked);
   * calls into GATE functions whose *internal* canary discipline is
     pinned by tests (`FinalExpChecker.check`/`_kernel_check`,
+    `PairingChecker.check`/`_kernel_check`,
     `PipelinedBlocksync._canary_check`): their returns are clean;
   * re-binding a name from any clean expression (a CPU re-verify).
 
@@ -69,6 +71,7 @@ SOURCE_METHODS = {
 }
 SOURCE_FUNCS = {
     f"{_PKG}.ops.bls12.final_exp_is_one_batch",
+    f"{_PKG}.ops.bls12.miller_finalexp_is_one_batch",
 }
 SANITIZERS = {
     f"{_PKG}.device.health.check_canaries",
@@ -80,6 +83,8 @@ SANITIZERS = {
 GATES = {
     f"{_PKG}.aggsig.verify.FinalExpChecker.check",
     f"{_PKG}.aggsig.verify.FinalExpChecker._kernel_check",
+    f"{_PKG}.aggsig.verify.PairingChecker.check",
+    f"{_PKG}.aggsig.verify.PairingChecker._kernel_check",
     f"{_PKG}.pipeline.scheduler.PipelinedBlocksync._canary_check",
 }
 SINK_QUALS = {
