@@ -565,6 +565,172 @@ def _aggsig_bench(miller_backend: str) -> int:
     return 0
 
 
+def _sealsync_mode() -> int:
+    """`bench.py --sealsync`: seal-adoption vs full-blocksync catch-up
+    A/B (docs/SEALSYNC.md). ALWAYS emits the one JSON line — even a
+    setup crash prints an error record so sweep harnesses never lose
+    the datapoint."""
+    try:
+        return _sealsync_bench()
+    except Exception as exc:  # noqa: BLE001 — the JSON line must land
+        print(json.dumps({"metric": "sealsync_time_to_decided",
+                          "error": f"{type(exc).__name__}: {exc}"}),
+              flush=True)
+        return 1
+
+
+def _sealsync_bench() -> int:
+    """Wide-valset catch-up A/B — aggregate-seal adoption vs full
+    blocksync over the SAME generated BLS chain (ROADMAP item 2,
+    docs/SEALSYNC.md).
+
+    Side A (sealsync): SealAdopter walks the seal chain, pairs only
+    the skip-schedule pivots, and installs every decided height as an
+    adopted-seal record — time-to-decided, no block bodies. Then the
+    body BACKFILL leg: a real BlocksyncReactor catch-up riding the
+    adopter's SigCache, where every adopted commit must be a
+    whole-aggregate cache hit (zero extra pairings).
+
+    Side B (baseline): plain full blocksync from scratch — one
+    aggregate pairing per commit plus body execution, the path a
+    laggard pays today.
+
+    Adoption runs FIRST so any one-time compile/warmup lands on side
+    A's clock — the reported speedup is conservative. Emits ONE JSON
+    line (kernel-bench schema) including per-side pairing-op deltas
+    and the compile-cache ledger attribution.
+
+    Env knobs: BENCH_SEAL_VALS (200), BENCH_SEAL_BLOCKS (8),
+    BENCH_SEAL_SKIP (4, pivot cadence)."""
+    n_vals = int(os.environ.get("BENCH_SEAL_VALS", "200"))
+    n_blocks = int(os.environ.get("BENCH_SEAL_BLOCKS", "8"))
+    max_skip = int(os.environ.get("BENCH_SEAL_SKIP", "4"))
+
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.aggsig.aggregate import reset_pop_registry
+    from cometbft_tpu.aggsig.verify import shared_pairing
+    from cometbft_tpu.crypto.bls12381 import OP_COUNTERS
+    from cometbft_tpu.db.kv import MemDB
+    from cometbft_tpu.engine.blocksync import BlocksyncReactor
+    from cometbft_tpu.engine.chain_gen import (ChainSealSource,
+                                               LocalChainSource,
+                                               generate_chain)
+    from cometbft_tpu.libs.jax_cache import ledger
+    from cometbft_tpu.libs.metrics import Registry
+    from cometbft_tpu.libs.metrics_gen import SealsyncMetrics
+    from cometbft_tpu.pipeline.cache import SigCache, reset_shared_cache
+    from cometbft_tpu.sealsync import SealAdopter
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State, StateStore
+    from cometbft_tpu.store.blockstore import BlockStore
+    from cometbft_tpu.types.agg_commit import AggregatedCommit
+
+    pc = shared_pairing()
+    _log(f"pairing checker backend: {pc.backend}")
+
+    _log(f"generating {n_blocks}-block BLS chain (aggregated seals), "
+         f"{n_vals} validators...")
+    t0 = time.perf_counter()
+    chain = generate_chain(
+        n_blocks=n_blocks, n_validators=n_vals, txs_per_block=1,
+        key_type="bls12_381", aggregate=True)
+    gen_s = time.perf_counter() - t0
+    for c in chain.seen_commits:
+        assert isinstance(c, AggregatedCommit)
+    tip = chain.max_height()
+
+    def catchup(store, cache) -> float:
+        """Real blocksync catch-up into `store`; `cache` is the
+        marshal-route SigCache (the adopter's on the backfill leg,
+        None on the baseline)."""
+        app = KVStoreApplication()
+        app.init_chain(chain.chain_id, 1, [], b"")
+        executor = BlockExecutor(app, state_store=StateStore(MemDB()),
+                                 block_store=store)
+        state = State.from_genesis(chain.genesis)
+        reactor = BlocksyncReactor(
+            executor, store, LocalChainSource(chain), chain.chain_id,
+            tile_size=8, batch_size=0, cache=cache)
+        t0 = time.perf_counter()
+        state = reactor.sync(state)
+        dt = time.perf_counter() - t0
+        assert state.last_block_height == tip
+        return dt
+
+    # ---- side A: seal adoption (time-to-decided), then backfill ----
+    reset_pop_registry()
+    reset_shared_cache()
+    a_state = State.from_genesis(chain.genesis)  # registers PoPs
+    a_store = BlockStore(MemDB())
+    a_cache = SigCache(65536)
+    metrics = SealsyncMetrics(Registry())
+    adopter = SealAdopter(
+        chain.chain_id, a_store, ChainSealSource(chain),
+        tile_size=8, max_skip=max_skip, cache=a_cache, shards=1,
+        metrics=metrics)
+    c0 = dict(OP_COUNTERS)
+    t0 = time.perf_counter()
+    adopted = adopter.adopt(a_state)
+    adopt_s = time.perf_counter() - t0
+    adopt_millers = OP_COUNTERS["miller_loops"] - c0["miller_loops"]
+    adopt_fexps = OP_COUNTERS["final_exps"] - c0["final_exps"]
+    assert adopted == tip and a_store.adopted_tip() == tip
+    pivots = int(metrics.pivots_verified.value())
+    skipped = int(metrics.pairings_skipped.value())
+    _log(f"seal adoption: decided through h={adopted} in "
+         f"{adopt_s:.2f}s — {pivots} pivot pairings, "
+         f"{skipped} heights adopted without pairing")
+
+    c0 = dict(OP_COUNTERS)
+    backfill_s = catchup(a_store, a_cache)
+    bf_millers = OP_COUNTERS["miller_loops"] - c0["miller_loops"]
+    bf_fexps = OP_COUNTERS["final_exps"] - c0["final_exps"]
+    _log(f"body backfill (adopter cache): {backfill_s:.2f}s — "
+         f"{bf_millers} Miller loops, {bf_fexps} final exps "
+         f"(adopted commits must be cache hits)")
+
+    # ---- side B: full blocksync from scratch (the baseline) ----
+    reset_pop_registry()
+    reset_shared_cache()
+    c0 = dict(OP_COUNTERS)
+    blocksync_s = catchup(BlockStore(MemDB()), None)
+    bs_millers = OP_COUNTERS["miller_loops"] - c0["miller_loops"]
+    bs_fexps = OP_COUNTERS["final_exps"] - c0["final_exps"]
+    _log(f"full blocksync: {blocksync_s:.2f}s — {bs_millers} Miller "
+         f"loops, {bs_fexps} final exps")
+
+    rec = {
+        "metric": "sealsync_time_to_decided",
+        "value": round(adopt_s, 3),
+        "unit": "s",
+        "vs_baseline": round(blocksync_s / adopt_s, 1),
+        "backend": pc.backend,
+        "validators": n_vals,
+        "blocks": n_blocks,
+        "max_skip": max_skip,
+        "adopt_s": round(adopt_s, 3),
+        "backfill_s": round(backfill_s, 3),
+        "adopt_plus_backfill_s": round(adopt_s + backfill_s, 3),
+        "blocksync_s": round(blocksync_s, 3),
+        "speedup_decided": round(blocksync_s / adopt_s, 1),
+        "speedup_full": round(blocksync_s / (adopt_s + backfill_s), 2),
+        "pivot_pairings": pivots,
+        "heights_adopted_without_pairing": skipped,
+        "pairing_ops": {
+            "adopt_miller_loops": adopt_millers,
+            "adopt_final_exps": adopt_fexps,
+            "backfill_miller_loops": bf_millers,
+            "backfill_final_exps": bf_fexps,
+            "blocksync_miller_loops": bs_millers,
+            "blocksync_final_exps": bs_fexps,
+        },
+        "chain_gen_s": round(gen_s, 2),
+        "compile_cache": ledger().attribution(),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
 def _measure_mesh_mode(n_devices: int, iters: int) -> int:
     """Child process: build the (commit, sig) topology over
     `n_devices`, warm the planned bucket (ledger-recorded under the
@@ -882,6 +1048,8 @@ if __name__ == "__main__":
             i = sys.argv.index("--miller-backend")
             mb = sys.argv[i + 1] if i + 1 < len(sys.argv) else ""
         sys.exit(_aggsig_mode(mb))
+    if len(sys.argv) > 1 and sys.argv[1] == "--sealsync":
+        sys.exit(_sealsync_mode())
     if len(sys.argv) > 1 and sys.argv[1] == "--mesh":
         sys.exit(_mesh_mode())
     sys.exit(main())

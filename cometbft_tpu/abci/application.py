@@ -19,10 +19,14 @@ CODE_TYPE_OK = 0
 
 @dataclass
 class ValidatorUpdate:
-    """reference abci/types.pb PubKeyBytes+Power update."""
+    """reference abci/types.pb PubKeyBytes+Power update. A BLS key
+    admitted mid-chain must carry its proof of possession in `pop`
+    (aggregation is unsound against rogue-key choices without one —
+    aggsig/aggregate.py); ed25519 updates leave it empty."""
     pub_key_type: str
     pub_key_bytes: bytes
     power: int
+    pop: bytes = b""
 
 
 @dataclass
@@ -95,7 +99,8 @@ class ResponseFinalizeBlock:
                            for r in self.tx_results],
             "validator_updates": [
                 {"type": u.pub_key_type, "pub_key": u.pub_key_bytes.hex(),
-                 "power": u.power} for u in self.validator_updates],
+                 "power": u.power, "pop": u.pop.hex()}
+                for u in self.validator_updates],
             "app_hash": self.app_hash.hex(),
         }).encode()
 
@@ -109,7 +114,8 @@ class ResponseFinalizeBlock:
                         for r in d["tx_results"]],
             validator_updates=[
                 ValidatorUpdate(u["type"], bytes.fromhex(u["pub_key"]),
-                                u["power"])
+                                u["power"],
+                                bytes.fromhex(u.get("pop", "")))
                 for u in d["validator_updates"]],
             app_hash=bytes.fromhex(d["app_hash"]))
 

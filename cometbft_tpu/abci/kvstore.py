@@ -107,12 +107,30 @@ class KVStoreApplication(BaseApplication):
     def _parse_validator_tx(self, tx: bytes) -> ValidatorUpdate:
         body = tx[len(VALIDATOR_PREFIX):].decode()
         if "!" not in body:
-            raise ValueError("val tx must be val:<pubkey_hex>!<power>")
-        pk_hex, power_s = body.split("!", 1)
+            raise ValueError(
+                "val tx must be val:<pubkey_hex>!<power>[!<pop_hex>]")
+        pk_hex, rest = body.split("!", 1)
+        pop = b""
+        if "!" in rest:
+            power_s, pop_hex = rest.split("!", 1)
+            pop = bytes.fromhex(pop_hex)
+        else:
+            power_s = rest
         pk = bytes.fromhex(pk_hex)
-        if len(pk) != 32:
-            raise ValueError("pubkey must be 32 bytes")
-        return ValidatorUpdate("ed25519", pk, int(power_s))
+        if len(pk) == 32:
+            if pop:
+                raise ValueError("ed25519 keys take no proof of possession")
+            return ValidatorUpdate("ed25519", pk, int(power_s))
+        if len(pk) == 48:
+            # compressed-G1 bls12_381 pubkey: a mid-chain BLS admission
+            # MUST ship its PoP or aggregation is rogue-key-unsound
+            # (genesis keys are admitted via GenesisDoc.bls_pops)
+            if len(pop) != 96:
+                raise ValueError(
+                    "bls12_381 validator tx needs a 96-byte proof of "
+                    "possession: val:<pk_hex>!<power>!<pop_hex>")
+            return ValidatorUpdate("bls12_381", pk, int(power_s), pop)
+        raise ValueError("pubkey must be 32 (ed25519) or 48 (bls) bytes")
 
     # --- consensus -----------------------------------------------------------
 

@@ -124,9 +124,14 @@ def pop_verify(pub_bytes: bytes, pop: bytes) -> bool:
 # Process-wide registry of pubkeys whose PoP verified TRUE. Populated
 # from genesis (state.State.from_genesis) and by callers admitting BLS
 # keys via validator updates; consulted by aggregate verification.
-# guarded-by: _POP_LOCK: _POP_OK
+# The verified PoP BYTES are retained alongside the flag: a seal
+# provider (sealsync/) must re-serve them to laggards crossing an
+# epoch boundary — a PoP is self-certifying, so re-serving costs no
+# trust, but it cannot be reconstructed from the flag alone.
+# guarded-by: _POP_LOCK: _POP_OK, _POP_BYTES
 _POP_LOCK = threading.Lock()
 _POP_OK: Dict[bytes, bool] = {}
+_POP_BYTES: Dict[bytes, bytes] = {}
 
 
 def register_pop(pub_bytes: bytes, pop: bytes, metrics=None) -> bool:
@@ -140,6 +145,7 @@ def register_pop(pub_bytes: bytes, pop: bytes, metrics=None) -> bool:
     if ok:
         with _POP_LOCK:
             _POP_OK[pub_bytes] = True
+            _POP_BYTES[pub_bytes] = pop
     elif metrics is not None:
         metrics.pop_rejections.inc()
     return ok
@@ -181,12 +187,13 @@ def _kernel_pop_check(pending, metrics=None):
             continue
         h = bls.hash_to_g2_cached(bls._fixed_msg(_pop_msg(pub)))
         items.append([(bls.G1_NEG, s), (pk.point, h)])
-        lanes.append(pub)
+        lanes.append((pub, pop))
     oks = pc.check(items) if items else []
     with _POP_LOCK:
-        for pub, ok in zip(lanes, oks):
+        for (pub, pop), ok in zip(lanes, oks):
             if ok:
                 _POP_OK[pub] = True
+                _POP_BYTES[pub] = pop
     for ok in oks:
         if not ok:
             all_ok = False
@@ -223,14 +230,15 @@ def register_pops_batch(pops: Dict[bytes, bytes], metrics=None) -> bool:
             all_ok = False
             continue
         bv.add(pk, _pop_msg(pub), pop)
-        lanes.append(pub)
+        lanes.append((pub, pop))
     if len(bv):
         batch_ok, oks = bv.verify()
         all_ok = all_ok and batch_ok
         with _POP_LOCK:
-            for pub, ok in zip(lanes, oks):
+            for (pub, pop), ok in zip(lanes, oks):
                 if ok:
                     _POP_OK[pub] = True
+                    _POP_BYTES[pub] = pop
         if metrics is not None:
             for ok in oks:
                 if not ok:
@@ -243,10 +251,20 @@ def has_pop(pub_bytes: bytes) -> bool:
         return bool(_POP_OK.get(pub_bytes))
 
 
+def registered_pop(pub_bytes: bytes) -> Optional[bytes]:
+    """The verified PoP bytes for `pub_bytes`, or None. Keys admitted
+    before PoP retention existed (flag only) also return None — the
+    seal provider then simply cannot attest that key's epoch, which is
+    a serving gap, never a soundness one."""
+    with _POP_LOCK:
+        return _POP_BYTES.get(pub_bytes)
+
+
 def reset_pop_registry() -> None:
     """Drop all registered PoPs (tests)."""
     with _POP_LOCK:
         _POP_OK.clear()
+        _POP_BYTES.clear()
 
 
 def valset_pops_ok(val_set) -> bool:

@@ -196,6 +196,13 @@ class BlockSyncConfig:
     device-backed nodes; 1 = the synchronous loop)."""
     version: str = "v0"
     pipeline_depth: int = 4
+    # sealsync (docs/SEALSYNC.md): adopt decided heights from aggregate
+    # seals before body backfill. Opt-in — the seal-adopt path only
+    # helps uniformly-BLS chains; mixed/ed25519 chains fall through to
+    # plain blocksync immediately.
+    seal_sync: bool = False
+    seal_max_skip: int = 64   # pairing cadence: pivot every N heights
+    seal_tile: int = 32       # seals settled per PairingChecker call
 
     def validate_basic(self) -> None:
         if self.version != "v0":
@@ -204,6 +211,13 @@ class BlockSyncConfig:
             raise ValueError(
                 f"pipeline_depth must be in [1, 64], "
                 f"got {self.pipeline_depth}")
+        if not 1 <= self.seal_max_skip <= 4096:
+            raise ValueError(
+                f"seal_max_skip must be in [1, 4096], "
+                f"got {self.seal_max_skip}")
+        if not 1 <= self.seal_tile <= 1024:
+            raise ValueError(
+                f"seal_tile must be in [1, 1024], got {self.seal_tile}")
 
 
 @dataclass

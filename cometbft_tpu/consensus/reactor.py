@@ -8,7 +8,12 @@ re-injection for late joiners).
 Channels (reference reactor.go:31-38):
   0x21 DataChannel  — proposals + block parts (bulk, lower priority)
   0x22 VoteChannel  — votes (latency-critical, higher priority)
-Wire: u8 kind || body. kinds: 1 proposal, 2 block part, 3 vote.
+Wire: u8 kind || body. kinds: 1 proposal, 2 block part, 3 vote,
+4 round state, 5 maj23 claim, 6 seal adopt (sealsync: an aggregate
+seal for the receiver's current height — votes_from_commit cannot
+reconstruct lanes from an AggregatedCommit, so the laggard catch-up
+serve hands over the seal itself; the receiver pairing-verifies it on
+the reactor thread before the state machine adopts).
 """
 
 from __future__ import annotations
@@ -22,7 +27,8 @@ from ..types import proto
 from ..types.block import BlockID, Commit, Part
 from ..types.vote import Vote, PRECOMMIT_TYPE, PREVOTE_TYPE
 from .state import (BlockPartMessage, ConsensusState, Message,
-                    ProposalMessage, VoteMessage, VoteSetMaj23Message)
+                    ProposalMessage, SealAdoptMessage, VoteMessage,
+                    VoteSetMaj23Message)
 from .wal import _decode_proposal, _encode_proposal
 
 DATA_CHANNEL = 0x21
@@ -33,6 +39,8 @@ _BLOCK_PART = 2
 _VOTE = 3
 _ROUND_STATE = 4
 _MAJ23 = 5
+_SEAL_ADOPT = 6  # aggregate seal for the receiver's current height
+#                  (sealsync; reactor-verified, never state-broadcast)
 
 
 class RoundStateMessage:
@@ -167,6 +175,12 @@ class ConsensusReactor:
     # consecutive heights; the refill rate bounds a hostile sweep
     CATCHUP_BURST = 4
     CATCHUP_REFILL_SECS = 2.0
+    # seal-adopt verification bucket: each accepted _SEAL_ADOPT costs a
+    # pairing on the reactor thread and the sender is unauthenticated —
+    # tighter than the catch-up bucket (one seal decides a height; a
+    # laggard needs at most one per refill as it finalizes)
+    SEAL_VERIFY_BURST = 2
+    SEAL_VERIFY_REFILL_SECS = 2.0
 
     def __init__(self, cs: ConsensusState):
         self.cs = cs
@@ -183,6 +197,8 @@ class ConsensusReactor:
         self._reconcile_stop = threading.Event()
         # (peer_id, height) -> count of precommits seen at height-1
         self._precommit_strikes: dict = {}
+        # peer.id -> (tokens, last_refill) for _SEAL_ADOPT verification
+        self._seal_budget: dict = {}
 
     def attach(self, switch) -> None:
         self._switch = switch
@@ -214,6 +230,9 @@ class ConsensusReactor:
     def receive(self, channel_id: int, peer, raw: bytes) -> None:
         if raw and raw[0] == _ROUND_STATE:
             self._on_round_state(RoundStateMessage.decode(raw[1:]), peer)
+            return
+        if raw and raw[0] == _SEAL_ADOPT:
+            self._on_seal_adopt_wire(raw[1:], peer)
             return
         msg = decode_consensus_msg(raw)
         if isinstance(msg, VoteMessage):
@@ -435,9 +454,21 @@ class ConsensusReactor:
             # peer parked in STEP_COMMIT (it already holds 2/3
             # precommits); a rounds-cycling extension-era laggard
             # catches up via blocksync on restart instead
-            for v in votes_from_commit(commit):
+            votes = votes_from_commit(commit)
+            for v in votes:
                 ch, raw = encode_consensus_msg(VoteMessage(v))
                 peer.try_send(ch, raw)
+            if not votes:
+                # AggregatedCommit: per-lane votes are folded away, so
+                # the laggard can never cross a 2/3 threshold from this
+                # serve — hand it the seal itself to adopt (sealsync;
+                # the receiver pairing-verifies before acting)
+                from ..types.agg_commit import AggregatedCommit
+                if isinstance(commit, AggregatedCommit):
+                    body = (proto.f_varint(1, h)
+                            + proto.f_bytes(2, commit.encode()))
+                    peer.try_send(VOTE_CHANNEL,
+                                  bytes([_SEAL_ADOPT]) + body)
         block = store.load_block(h)
         if block is None:
             return
@@ -447,6 +478,60 @@ class ConsensusReactor:
             ch, raw = encode_consensus_msg(
                 BlockPartMessage(h, commit.round, part))
             peer.try_send(ch, raw)
+
+    def _on_seal_adopt_wire(self, body: bytes, peer) -> None:
+        """Verify a peer-served aggregate seal for our CURRENT height
+        and, only if the pairing settles TRUE against our own validator
+        set, inject a SealAdoptMessage into the state machine. All
+        checks (and the rate limit) run BEFORE any crypto: the sender
+        is unauthenticated and each pairing is the priciest single
+        check in the node — this runs on the reactor thread precisely
+        so a garbage seal can never stall the consensus thread."""
+        cs = self.cs
+        rs = cs.rs
+        f = proto.parse_fields(body)
+        h = proto.to_int64(proto.field_int(f, 1, 0))
+        if h != rs.height:
+            return
+        if cs.state.consensus_params.extensions_enabled(h):
+            return  # the state machine would refuse; skip the pairing
+        now = timesource.monotonic()
+        tokens, last = self._seal_budget.get(
+            peer.id, (self.SEAL_VERIFY_BURST, now))
+        tokens = min(self.SEAL_VERIFY_BURST,
+                     tokens + (now - last) / self.SEAL_VERIFY_REFILL_SECS)
+        if tokens < 1.0:
+            return
+        self._sweep_stale(self._seal_budget, now, lambda v: v[1])
+        self._seal_budget[peer.id] = (tokens - 1.0, now)
+        from ..types.agg_commit import AggregatedCommit
+        try:
+            commit = Commit.decode(proto.field_bytes(f, 2, b""))
+        except (ValueError, IndexError):
+            return
+        if not isinstance(commit, AggregatedCommit) or \
+                commit.height != h:
+            return
+        from ..aggsig.verify import prepare_full_commit, settle_seals
+        from ..pipeline.cache import shared_cache
+        vals = cs.state.validators
+        needed = vals.total_voting_power() * 2 // 3
+        cache = shared_cache()
+        try:
+            seal = prepare_full_commit(cs.chain_id, vals, commit,
+                                       needed, cache=cache)
+            ok = settle_seals([seal], cache=cache)[0]
+        except (ValueError, KeyError):
+            ok = False
+        if not ok:
+            # a structurally-valid seal that fails the pairing is a
+            # deliberate forgery, never noise — drop the peer
+            if self._switch is not None:
+                self._switch.stop_peer(
+                    peer, f"forged aggregate seal at height {h}",
+                    ban=True)
+            return
+        cs.send(SealAdoptMessage(commit), peer_id=peer.id)
 
     def _broadcast(self, msg: Message) -> None:
         if self._switch is None:

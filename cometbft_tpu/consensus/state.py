@@ -110,6 +110,21 @@ class VoteSetMaj23Message:
 
 
 @dataclass(frozen=True)
+class SealAdoptMessage:
+    """An aggregate seal for the receiver's CURRENT height (sealsync's
+    consensus-layer leg, docs/SEALSYNC.md): an AggregatedCommit folds
+    per-lane signatures away, so a laggard can never reconstruct the
+    decided precommits from it — it adopts the seal itself instead.
+    The REACTOR verifies the pairing against this node's own validator
+    set before injecting (the expensive check stays off the
+    single-writer thread); the state machine then treats the height as
+    decided and waits only for block parts. Not WAL-logged: like
+    VoteSetMaj23Message it is re-derivable — any up-to-date peer
+    re-serves it on the next round-state reconcile."""
+    commit: Commit
+
+
+@dataclass(frozen=True)
 class _BroadcastMarker:
     """Internal-queue entry: gossip `msg` once the local deliveries
     queued ahead of it have been processed (see
@@ -118,7 +133,7 @@ class _BroadcastMarker:
 
 
 Message = Union[ProposalMessage, BlockPartMessage, VoteMessage,
-                VoteSetMaj23Message, TimeoutInfo]
+                VoteSetMaj23Message, SealAdoptMessage, TimeoutInfo]
 
 
 # Thread-confinement checking (the Python analog of the reference's
@@ -165,6 +180,10 @@ class RoundState:
     commit_round: int = -1
     last_commit: Optional[VoteSet] = None
     triggered_timeout_precommit: bool = False
+    # aggregate seal adopted for THIS height (sealsync): when set, the
+    # commit/finalize paths take its block_id as the decided id instead
+    # of a precommit 2/3 majority, and it becomes the seen commit
+    adopted_commit: Optional[Commit] = None
 
     def claim(self, tid: int) -> None:
         """Record thread `tid` as this round state's owner. The claim
@@ -345,6 +364,11 @@ class ConsensusState:
             # a hint, not a vote: not WAL-logged (a lost claim is
             # re-announced by whichever peer serves the catch-up again)
             self._on_maj23(msg, peer_id)
+            return
+        if isinstance(msg, SealAdoptMessage):
+            # like Maj23, re-derivable: the serving peer re-sends the
+            # seal on its next reconcile tick, so no WAL entry
+            self._on_seal_adopt(msg)
             return
         if isinstance(msg, ProposalMessage):
             if not self._replaying:
@@ -614,7 +638,9 @@ class ConsensusState:
             # 2/3-precommitted block_id (enterCommit), possibly while a
             # stale same-height proposal from a later round is still in
             # rs.proposal — authenticate against the decided id, not it
-            bid = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+            bid = rs.adopted_commit.block_id \
+                if rs.adopted_commit is not None else \
+                rs.votes.precommits(rs.commit_round).two_thirds_majority()
             if bid is not None and block.hash() != bid.hash:
                 return
         elif rs.proposal is not None and \
@@ -796,6 +822,48 @@ class ConsensusState:
             max(self.config.timeout_precommit, 500), self.rs.height,
             self.rs.round, STEP_COMMIT))
 
+    def _on_seal_adopt(self, msg: SealAdoptMessage) -> None:
+        """Adopt an aggregate seal for the CURRENT height (sealsync,
+        docs/SEALSYNC.md). The reactor already settled the pairing
+        against this node's own validator set before injecting
+        (consensus/reactor.py _on_seal_adopt_wire) — here we take only
+        the structural step: treat the height as decided, allocate the
+        part set from the sealed block_id, and finalize once the body
+        completes. Mirrors _enter_commit minus the 2/3-precommit
+        assertion (per-lane votes are folded away in the seal and can
+        never be reconstructed)."""
+        rs = self.rs
+        commit = msg.commit
+        if commit.height != rs.height or rs.step >= STEP_COMMIT:
+            return
+        if self.state.consensus_params.extensions_enabled(rs.height):
+            # an adopted seal carries no vote extensions and the next
+            # proposer would need them — fall back to vote catch-up
+            return
+        try:
+            commit.validate_basic()
+        except ValueError:
+            return
+        bid = commit.block_id
+        if bid.is_nil():
+            return
+        rs.adopted_commit = commit
+        rs.step = STEP_COMMIT
+        rs.commit_round = commit.round
+        if rs.locked_block is not None and \
+                rs.locked_block.hash() == bid.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or \
+                rs.proposal_block.hash() != bid.hash:
+            if rs.proposal_block_parts is None or \
+                    rs.proposal_block_parts.header != bid.parts:
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet.new_from_header(bid.parts)
+            self._schedule_commit_retry()
+            return
+        self._try_finalize_commit(rs.height)
+
     def _commit_retry(self) -> None:
         """Still in STEP_COMMIT with an incomplete decided block:
         re-broadcast a vote for this height (peers answer votes for
@@ -830,7 +898,9 @@ class ConsensusState:
         rs = self.rs
         if rs.height != height or rs.step != STEP_COMMIT:
             return
-        bid = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        bid = rs.adopted_commit.block_id \
+            if rs.adopted_commit is not None else \
+            rs.votes.precommits(rs.commit_round).two_thirds_majority()
         if bid is None or bid.is_nil():
             return
         if rs.proposal_block is None or \
@@ -845,7 +915,14 @@ class ConsensusState:
         parts = rs.proposal_block_parts
         bid = BlockID(block.hash(), parts.header)
         precommits = rs.votes.precommits(rs.commit_round)
-        seen_commit = precommits.make_commit()
+        if rs.adopted_commit is not None:
+            # sealsync: the seal IS the seen commit — per-lane votes
+            # were never reconstructible from it (adoption is refused
+            # while vote extensions are enabled, so `extended` below
+            # stays None on this path)
+            seen_commit = rs.adopted_commit
+        else:
+            seen_commit = precommits.make_commit()
         extended = None
         if self.state.consensus_params.extensions_enabled(height):
             # persist extensions beside the block: a restarted proposer

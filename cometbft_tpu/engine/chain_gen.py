@@ -201,6 +201,88 @@ class LocalChainSource:
         self.corrupt.clear()
 
 
+class ChainSealSource:
+    """sealsync.SealSource over a generated chain — the in-memory seal
+    provider for tests, the seal-adoption simnet scenario, and
+    bench.py --sealsync. Corrupt modes:
+
+      "sig"     flip a byte of the tip seal's aggregate signature
+                (structural/point-level rejection at marshal)
+      "bitmap"  deep forgery: aggregate only n-1 real signatures but
+                keep the full-coverage bitmap — structure-valid,
+                voting-power tally passes, the PAIRING is what rejects
+
+    Forgeries are only served at heights in `corrupt_heights` (serve
+    the tip: interior forgeries are caught earlier and cheaper by the
+    host hash-chain binding). ban() clears corruption, modeling the
+    retry landing on the honest peer."""
+
+    def __init__(self, chain: GeneratedChain,
+                 corrupt_heights: Dict[int, str] | None = None):
+        self.chain = chain
+        self.corrupt = corrupt_heights or {}
+        self.banned: List[int] = []
+
+    def max_height(self) -> int:
+        return self.chain.max_height()
+
+    def fetch_seals(self, start: int, count: int):
+        from ..sealsync.chain import SealTuple
+        from ..types.agg_commit import AggregatedCommit
+        out = []
+        for h in range(start, min(start + count,
+                                  self.chain.max_height() + 1)):
+            commit = self.chain.seen_commits[h - 1]
+            if not isinstance(commit, AggregatedCommit):
+                break
+            if h in self.corrupt:
+                commit = _forge_seal(self.chain, commit,
+                                     self.corrupt[h])
+            header = self.chain.blocks[h - 1].header
+            valset = None
+            pops: Dict[bytes, bytes] = {}
+            if h > 1 and header.validators_hash != \
+                    self.chain.blocks[h - 2].header.validators_hash:
+                valset = self.chain.valsets[h - 1].copy()
+                pops = _valset_pops(self.chain, valset)
+            out.append(SealTuple(h, header, commit, valset, pops))
+        return out
+
+    def ban(self, height: int) -> None:
+        self.banned.append(height)
+        self.corrupt.clear()
+
+
+def _valset_pops(chain: GeneratedChain, valset) -> Dict[bytes, bytes]:
+    from ..aggsig.aggregate import pop_prove
+    pops: Dict[bytes, bytes] = {}
+    for v in valset.validators:
+        if v.pub_key.type_() != "bls12_381":
+            continue
+        priv = chain.keys.get(v.address)
+        if priv is not None:
+            pops[v.pub_key.bytes_()] = pop_prove(priv)
+    return pops
+
+
+def _forge_seal(chain: GeneratedChain, commit, mode: str):
+    import dataclasses
+    if mode == "sig":
+        return dataclasses.replace(
+            commit, agg_sig=commit.agg_sig[:1]
+            + bytes([commit.agg_sig[1] ^ 1]) + commit.agg_sig[2:])
+    if mode == "bitmap":
+        from ..aggsig.aggregate import aggregate_signatures
+        vals = chain.valsets[commit.height - 1]
+        # uniform timestamps -> one canonical message for every lane
+        msg = commit.vote_sign_bytes(chain.chain_id, 0)
+        sigs = [chain.keys[v.address].sign(msg)
+                for v in vals.validators[:-1]]
+        return dataclasses.replace(commit,
+                                   agg_sig=aggregate_signatures(sigs))
+    raise ValueError(mode)
+
+
 def _sealing_header(chain: GeneratedChain):
     from ..types.block import Header
     return Header(chain_id=chain.chain_id,

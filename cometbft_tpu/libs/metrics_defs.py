@@ -184,6 +184,31 @@ METRICS_SPEC = {
         ("gauge", "ring_occupancy", "trace_ring_occupancy",
          "Spans currently resident in the flight-recorder ring", ()),
     ],
+    # sealsync/ — aggregate-seal catch-up (provider.py serving,
+    # adopter.py settlement + install; docs/SEALSYNC.md). The headline
+    # ratio is pairings_skipped / (pivots_verified + pairings_skipped):
+    # the fraction of decided heights adopted without their own pairing
+    "SealsyncMetrics": [
+        ("counter", "seals_served", "sealsync_seals_served",
+         "Seal tuples served to catching-up peers", ()),
+        ("counter", "serve_sheds", "sealsync_serve_sheds",
+         "Seal-range requests shed by provider backpressure", ()),
+        ("counter", "seals_adopted", "sealsync_seals_adopted",
+         "Decided heights adopted from seals (pivot or skipped)", ()),
+        ("counter", "pivots_verified", "sealsync_pivots_verified",
+         "Pivot seals settled through the pairing checker", ()),
+        ("counter", "pairings_skipped", "sealsync_pairings_skipped",
+         "Adopted heights whose pairing was elided by hash-chain "
+         "binding to a verified pivot", ()),
+        ("counter", "adoptions_rejected", "sealsync_adoptions_rejected",
+         "Seal spans rejected (chain-rule violation, bad epoch PoP, "
+         "or forged pivot pairing)", ()),
+        ("counter", "pop_rejections", "sealsync_pop_rejections",
+         "Epoch validator-set PoPs that failed verification during "
+         "adoption", ()),
+        ("gauge", "adopted_tip", "sealsync_adopted_tip",
+         "Highest height with adopted (seal-derived) finality", ()),
+    ],
     # reference mempool/metrics.go
     "MempoolMetrics": [
         ("gauge", "size", "mempool_size",

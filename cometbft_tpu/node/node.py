@@ -303,7 +303,17 @@ class Node:
             self.metrics_registry)
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.consensus_reactor.attach(self.switch)
-        self.blocksync_reactor = BlocksyncNetReactor(self.block_store)
+        # every node SERVES seals (the provider reads straight out of
+        # the stores, zero cost when nobody asks); CONSUMING them at
+        # boot is gated by [blocksync] seal_sync below
+        from ..libs.metrics_gen import SealsyncMetrics
+        from ..sealsync import SealProvider
+        self.sealsync_metrics = SealsyncMetrics(self.metrics_registry)
+        self.seal_provider = SealProvider(
+            self.block_store, state_store=self.state_store,
+            metrics=self.sealsync_metrics)
+        self.blocksync_reactor = BlocksyncNetReactor(
+            self.block_store, seal_provider=self.seal_provider)
         from ..mempool.reactor import MempoolReactor
         self.mempool_reactor = MempoolReactor(self.mempool,
                                               ingest=self.ingest)
@@ -349,7 +359,7 @@ class Node:
             switch=self.switch,
             evidence_pool=self.evidence_pool,
             unsafe=config.rpc.unsafe, farm=self.farm,
-            ingest=self.ingest)
+            ingest=self.ingest, sealsync=self.seal_provider)
         self.rpc_server: Optional[RPCServer] = None
         if config.rpc.enable:
             host, port = self._split_addr(config.rpc.laddr)
@@ -562,6 +572,27 @@ class Node:
                 synced = None
             if synced is not None:
                 state = synced
+        if self.config.blocksync.seal_sync:
+            # sealsync (docs/SEALSYNC.md): adopt decided heights from
+            # aggregate seals FIRST — O(pivots) pairings for the whole
+            # gap instead of one per height — then let the blocksync
+            # loop below backfill bodies (every adopted commit is a
+            # SigCache hit, so backfill re-verifies nothing)
+            from ..sealsync import AdoptionError, SealAdopter
+            from ..engine.reactor import NetSealSource
+            bs = self.config.blocksync
+            try:
+                SealAdopter(
+                    self.genesis.chain_id, self.block_store,
+                    NetSealSource(self.blocksync_reactor, self.switch),
+                    tile_size=bs.seal_tile, max_skip=bs.seal_max_skip,
+                    cache=shared_cache(),
+                    metrics=self.sealsync_metrics).adopt(state)
+            except AdoptionError:
+                # adoption is an accelerator, never a gate: a corrupt
+                # or seal-less peer set just means plain blocksync
+                import traceback
+                traceback.print_exc()
         # catch up until no peer is ahead (each pass re-queries peer
         # status; a fresh net reports height 0 and falls through fast)
         for _round in range(100):

@@ -39,12 +39,16 @@ class RPCEnvironment:
                  tx_indexer=None, block_indexer=None, app_query=None,
                  genesis=None, switch=None, state_getter=None,
                  evidence_pool=None, unsafe=False, farm=None,
-                 ingest=None):
+                 ingest=None, sealsync=None):
         self.chain_id = chain_id
         # farm/service.VerificationFarm when the node serves light
         # verification as a product; None leaves the light_* routes
         # unmounted
         self.farm = farm
+        # sealsync/provider.SealProvider when the node serves aggregate
+        # seals for catch-up (docs/SEALSYNC.md); None leaves the seal_*
+        # routes unmounted
+        self.sealsync = sealsync
         # ingest/admission.IngestPipeline when [mempool] ingest_batch
         # is on: broadcast_tx_* then park on a batch ticket instead of
         # walking a synchronous check_tx (docs/INGEST.md)
@@ -648,6 +652,37 @@ class Routes:
         farm = self._farm()
         return {"dropped": farm.unsubscribe(str(session))}
 
+    # --- aggregate-seal catch-up (sealsync/provider.py) -----------------------
+
+    def _sealsync(self):
+        if self.env.sealsync is None:
+            raise RPCError(-32603, "sealsync provider not enabled")
+        return self.env.sealsync
+
+    def seal_status(self) -> dict:
+        """The height span this node can serve seals for."""
+        base, sealable = self._sealsync().status()
+        return {"base": str(base), "sealable_height": str(sealable)}
+
+    def seal_range(self, start=None, count=None) -> dict:
+        """Seal tuples [start, start+count): hex-encoded SealTuple wire
+        records (sealsync/chain.py). Truncation is honest — a shorter
+        prefix means the provider hit its batch cap or its sealable
+        tip; backpressure sheds with the retryable -32005."""
+        from ..sealsync import SealsyncOverloaded
+        if start is None:
+            raise RPCError(-32602, "start required")
+        prov = self._sealsync()
+        try:
+            tuples = prov.serve(int(start),
+                                int(count) if count is not None else 1)
+        except SealsyncOverloaded as e:
+            raise RPCError(-32005, f"sealsync overloaded: {e}")
+        except ValueError as e:
+            raise RPCError(-32602, str(e))
+        return {"start": str(int(start)),
+                "seals": [t.encode().hex() for t in tuples]}
+
     # --- events (long-poll stand-in for the WS subscription) ------------------
 
     def wait_event(self, query="", timeout=None) -> dict:
@@ -712,6 +747,9 @@ class RPCServer:
                 # only when the node carries a farm
                 names += ["light_subscribe", "light_verify",
                           "light_status", "light_unsubscribe"]
+            if env is not None and env.sealsync is not None:
+                # aggregate-seal catch-up routes (docs/SEALSYNC.md)
+                names += ["seal_status", "seal_range"]
             methods = {name: getattr(routes, name) for name in names}
 
         class Handler(BaseHTTPRequestHandler):

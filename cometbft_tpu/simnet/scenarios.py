@@ -25,6 +25,7 @@ from .flash_crowd import run_flash_crowd as _run_flash_crowd
 from .harness import Scenario, Simulation
 from .light_farm import run_light_farm as _run_light_farm
 from .mesh_degrade import run_mesh_degrade as _run_mesh_degrade
+from .seal_adoption import run_seal_adoption as _run_seal_adoption
 from .transport import LinkPolicy
 
 
@@ -244,6 +245,15 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
              "forged-bitmap / undercount chains",
              target_height=3, deadline_ms=120_000, quick_target=2,
              runner=_run_bls_valset),
+    Scenario("seal-adoption", "a laggard adopts a wide BLS valset "
+             "chain from aggregate seals alone (sealsync): the one "
+             "corrupt provider's forged seal and forged bitmap both "
+             "reject at the pivot pairing, adoption completes via the "
+             "honest peer across a mid-chain epoch boundary (PoP-"
+             "carrying val-update tx), and body backfill re-pairs "
+             "nothing — every adopted commit is a SigCache hit",
+             target_height=20, deadline_ms=0, quick_target=8,
+             runner=_run_seal_adoption),
     Scenario("mesh-degrade", "one mesh shard answers corrupt canary "
              "verdicts: the shard is quarantined, the mesh re-factors "
              "smaller, a real blocksync completes with zero corrupt "

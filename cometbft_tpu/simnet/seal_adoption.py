@@ -1,0 +1,173 @@
+"""seal-adoption scenario: a laggard adopts a wide-valset BLS chain
+from aggregate seals alone — one corrupt provider included.
+
+Phase 1 — forgery rejection, one run per corrupt mode:
+  "sig"     the tip seal's aggregate signature with a flipped byte —
+            structural/point-level rejection
+  "bitmap"  a DEEP forgery: only n-1 signatures aggregated but the
+            bitmap claims full coverage — structure-valid, the
+            voting-power tally passes, and only the PAIRING can say no
+Each run's first attempt must reject (the adopter bans the span, the
+retry models landing on the honest peer) and adoption must then
+complete: every height carries an adopted seal record and the
+blockstore's adopted tip reaches the chain tip. The chain includes a
+mid-chain BLS validator admission (val-update tx with its proof of
+possession — the PoP-delivery path), so adoption also crosses a real
+epoch boundary whose valset bytes + PoPs arrive IN the seal stream.
+
+Phase 2 — backfill economy: re-marshal every height's commit with the
+adopter's SigCache, the way blocksync's marshal_commit would during
+body backfill. Every height must come back "ok" (cache hit) — an
+adopted height is never paired twice. The pairing ledger must show
+skipped heights outnumbering pivots (the whole point of the skip
+schedule).
+
+Everything is a pure function of (scenario, seed): keys and the chain
+come from seeded generators, settlement runs serially on a private
+CPU checker, and the event log is byte-identical per seed (pinned by
+tests/test_simnet.py like every other scenario).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import time
+from typing import List
+
+from ..aggsig.aggregate import pop_prove, reset_pop_registry
+from ..aggsig.verify import PairingChecker, prepare_full_commit
+from ..crypto import bls12381 as bls
+from ..db.kv import MemDB
+from ..engine.chain_gen import ChainSealSource, generate_chain
+from ..libs.metrics import Registry
+from ..libs.metrics_gen import SealsyncMetrics
+from ..pipeline.cache import SigCache
+from ..sealsync import SealAdopter
+from ..state.state import State
+from ..store.blockstore import BlockStore
+from .harness import Scenario, SimResult
+
+MAX_SKIP = 4  # pivot cadence: small enough that every run has both
+#               skip-scheduled and epoch-boundary pivots
+
+
+def _make_chain(seed: int, n_vals: int, n_blocks: int):
+    """A uniformly-BLS chain with one mid-chain validator admission:
+    the val-update tx at height 2 (pk + power + PoP) changes the set
+    at height 4 — the epoch boundary the adopter must cross."""
+    rng = random.Random(0x5EA1 ^ seed)
+    joiner = bls.Bls12381PrivKey.generate(rng.randbytes(32))
+    pk = joiner.pub_key().bytes_()
+    tx = (b"val:" + pk.hex().encode() + b"!10!"
+          + pop_prove(joiner).hex().encode())
+    return generate_chain(
+        n_blocks=n_blocks, n_validators=n_vals,
+        chain_id=f"seal-adopt-{seed}", seed=seed,
+        key_type="bls12_381", aggregate=True, txs_per_block=1,
+        val_tx_heights={2: tx}, extra_keys=[joiner])
+
+
+def _adoption_run(chain, mode: str, log: List[str],
+                  violations: List[str]):
+    """One laggard adoption against a provider serving a forged tip
+    seal in `mode`; returns (store, cache, metrics) for phase 2."""
+    tip = chain.max_height()
+    reset_pop_registry()
+    state = State.from_genesis(chain.genesis)  # registers genesis PoPs
+    source = ChainSealSource(chain, corrupt_heights={tip: mode})
+    store = BlockStore(MemDB())
+    cache = SigCache(4096)
+    metrics = SealsyncMetrics(Registry())
+    adopter = SealAdopter(
+        chain.chain_id, store, source, tile_size=8, max_skip=MAX_SKIP,
+        cache=cache, checker=PairingChecker("cpu"), shards=1,
+        metrics=metrics)
+    adopted = adopter.adopt(state)
+    rejected = int(metrics.adoptions_rejected.value())
+    log.append(f"forge mode={mode} rejected={rejected} "
+               f"banned={source.banned} adopted={adopted}")
+    if rejected < 1 or tip not in source.banned:
+        violations.append(f"forged {mode} seal was not rejected")
+        log.append(f"violation msg=forgery_accepted_{mode}")
+    if adopted != tip or store.adopted_tip() != tip:
+        violations.append(
+            f"adoption incomplete under {mode} forgery: "
+            f"{adopted}/{tip}")
+        log.append(f"violation msg=adoption_incomplete_{mode}")
+    missing = [h for h in range(1, tip + 1)
+               if store.load_adopted_seal(h) is None]
+    if missing:
+        violations.append(f"adopted seal records missing: {missing}")
+        log.append(f"violation msg=seal_records_missing_{mode}")
+    pivots = int(metrics.pivots_verified.value())
+    skipped = int(metrics.pairings_skipped.value())
+    log.append(f"pairing_ledger mode={mode} pivots={pivots} "
+               f"skipped={skipped}")
+    if skipped <= 0 or skipped < pivots - len(source.banned):
+        violations.append(
+            f"skip schedule bought nothing: pivots={pivots} "
+            f"skipped={skipped}")
+        log.append(f"violation msg=no_pairings_skipped_{mode}")
+    return store, cache, metrics
+
+
+def _backfill_phase(chain, cache: SigCache, log: List[str],
+                    violations: List[str]) -> None:
+    """Blocksync-backfill stand-in: marshal every adopted commit with
+    the adopter's cache — all must come back "ok" without a pairing."""
+    hits = 0
+    for h in range(1, chain.max_height() + 1):
+        vals = chain.valsets[h - 1]
+        commit = chain.seen_commits[h - 1]
+        needed = vals.total_voting_power() * 2 // 3
+        seal = prepare_full_commit(chain.chain_id, vals, commit,
+                                   needed, cache=cache)
+        if seal.status == "ok":
+            hits += 1
+        else:
+            violations.append(
+                f"backfill re-pairing at height {h}: adopted commit "
+                f"missed the cache ({seal.status})")
+            log.append(f"violation msg=backfill_miss_h{h}")
+    log.append(f"backfill cache_hits={hits}/{chain.max_height()}")
+
+
+def run_seal_adoption(scenario: Scenario, seed: int, quick: bool = False,
+                      workdir=None) -> SimResult:
+    """Scenario runner (scenarios.py dispatches here; `workdir` is part
+    of the runner contract but unused — everything is in-memory)."""
+    t0 = time.monotonic()  # staticcheck: allow(wallclock) — wall_s only
+    n_vals = 16 if quick else 200
+    n_blocks = scenario.quick_target if quick else scenario.target_height
+    log: List[str] = []
+    violations: List[str] = []
+    chain = _make_chain(seed, n_vals, n_blocks)
+    log.append(f"chain vals={n_vals} blocks={n_blocks} "
+               f"epoch_at=4 tip_vh={chain.blocks[-1].header.validators_hash.hex()}")
+    cache = None
+    pivots = skipped = rejected = 0
+    for mode in ("sig", "bitmap"):
+        _store, cache, metrics = _adoption_run(chain, mode, log,
+                                               violations)
+        pivots += int(metrics.pivots_verified.value())
+        skipped += int(metrics.pairings_skipped.value())
+        rejected += int(metrics.adoptions_rejected.value())
+    _backfill_phase(chain, cache, log, violations)
+    log.append(f"seal_adoption_end violations={len(violations)}")
+    digest = hashlib.sha256()
+    for line in log:
+        digest.update(line.encode())
+        digest.update(b"\n")
+    return SimResult(
+        scenario=scenario.name, seed=seed, violations=violations,
+        max_height=chain.max_height(),
+        heights={0: chain.max_height()}, app_hashes={},
+        log_lines=log, digest=digest.hexdigest(),
+        wall_s=time.monotonic() - t0,  # staticcheck: allow(wallclock)
+        virtual_s=0.0, commits_per_sim_s=0.0, crashes=0, restarts=0,
+        evidence_seen=0, errors=[],
+        # delivered = heights adopted without their own pairing,
+        # dropped = forged spans rejected, blocked = pivot pairings
+        stats={"delivered": skipped, "dropped": rejected,
+               "blocked": pivots, "events": len(log)})

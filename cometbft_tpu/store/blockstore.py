@@ -6,7 +6,11 @@ Key layout (height big-endian so byte order == height order for scans):
   P:<height>:<i>  -> part bytes
   C:<height>      -> canonical commit for height (block h+1's LastCommit)
   SC:<height>     -> seen commit (the commit this node observed)
-  base / height   -> chain span markers
+  AS:<height>     -> adopted seal (block_id || header || commit) — a
+                     height finalized via sealsync whose BODY has not
+                     backfilled yet; never advances base/height, and
+                     save_block deletes it when the body arrives
+  base / height / adopted_tip -> chain span markers
 """
 
 from __future__ import annotations
@@ -20,6 +24,7 @@ from ..types.block import Block, BlockID, Commit, Header, PartSet
 
 _KEY_BASE = b"blockstore:base"
 _KEY_HEIGHT = b"blockstore:height"
+_KEY_ADOPTED_TIP = b"blockstore:adopted_tip"
 
 
 def _h(prefix: bytes, height: int) -> bytes:
@@ -32,8 +37,10 @@ class BlockStore:
         self._lock = threading.RLock()
         b = db.get(_KEY_BASE)
         h = db.get(_KEY_HEIGHT)
+        a = db.get(_KEY_ADOPTED_TIP)
         self._base = int.from_bytes(b, "big") if b else 0
         self._height = int.from_bytes(h, "big") if h else 0
+        self._adopted_tip = int.from_bytes(a, "big") if a else 0
 
     def base(self) -> int:
         with self._lock:
@@ -84,7 +91,12 @@ class BlockStore:
             new_base = self._base or height
             sets.append((_KEY_BASE, new_base.to_bytes(8, "big")))
             sets.append((_KEY_HEIGHT, height.to_bytes(8, "big")))
-            self._db.write_batch(sets)
+            deletes = []
+            if height <= self._adopted_tip:
+                # body backfilled for an adopted height: the canonical
+                # H:/P:/SC: keys now own it, drop the seal record
+                deletes.append(_h(b"AS:", height))
+            self._db.write_batch(sets, deletes)
             self._base, self._height = new_base, height
 
     def load_block(self, height: int) -> Optional[Block]:
@@ -140,6 +152,48 @@ class BlockStore:
         propose at height+1 before any block exists locally."""
         with self._lock:
             self._db.set(_h(b"SC:", height), commit.encode())
+
+    # --- adopted seals (sealsync/) ----------------------------------------
+
+    def adopted_tip(self) -> int:
+        """Highest height with adopted finality (0 = none). Distinct
+        from height(): adopted heights have NO body yet — blocksync
+        backfill is what moves height() up to meet it."""
+        with self._lock:
+            return self._adopted_tip
+
+    def save_adopted_seal(self, height: int, block_id: BlockID,
+                          header: Header, commit: Commit) -> None:
+        """Record adopted finality for `height` WITHOUT advancing
+        base/height (sealsync install — the body arrives later via
+        save_block, which supersedes this record). Contiguity is
+        enforced against the combined tip so the adopted span always
+        extends the chain; rewriting an already-adopted height is
+        idempotent (adoption resume replans the whole span)."""
+        with self._lock:
+            tip = max(self._height, self._adopted_tip)
+            if tip and height > tip + 1:
+                raise ValueError(
+                    f"non-contiguous adopted seal: tip {tip}, "
+                    f"got {height}")
+            raw = (proto.f_embed(1, block_id.encode())
+                   + proto.f_embed(2, header.encode())
+                   + proto.f_embed(3, commit.encode()))
+            sets = [(_h(b"AS:", height), raw)]
+            new_tip = max(self._adopted_tip, height)
+            sets.append((_KEY_ADOPTED_TIP, new_tip.to_bytes(8, "big")))
+            self._db.write_batch(sets)
+            self._adopted_tip = new_tip
+
+    def load_adopted_seal(self, height: int
+                          ) -> Optional[tuple[BlockID, Header, Commit]]:
+        raw = self._db.get(_h(b"AS:", height))
+        if raw is None:
+            return None
+        f = proto.parse_fields(raw)
+        return (BlockID.decode(proto.field_one(f, 1, b"")),
+                Header.decode(proto.field_one(f, 2, b"")),
+                Commit.decode(proto.field_one(f, 3, b"")))
 
     def delete_block(self, height: int) -> None:
         """Remove the TIP block (reference store/store.go

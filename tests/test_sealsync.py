@@ -1,0 +1,431 @@
+"""sealsync/ — aggregate-seal catch-up (finalize decided heights from
+seals, not signature replay).
+
+Pins, bottom-up: the SealTuple wire form (including the epoch payload
+of valset bytes + PoPs), plan_adoption's host-side trust rule (every
+continuity arc raises SealChainError at the FIRST offending height,
+before any pairing), the pivot schedule invariants (tip + epoch-last
++ bounded skip), the adopter's end-to-end arc over an in-memory source
+(clean adoption, deep-forgery rejection + honest-peer retry, retries
+exhausted, install refusal as the verdict-taint sink), the
+no-double-pairing cache contract (every adopted height is a
+whole-aggregate SigCache hit on backfill), the blockstore's AS:
+record lifecycle (contiguity, supersede-on-backfill), the provider's
+serving rules (adopted records count, prefix semantics at a boundary
+it cannot attest, inflight shedding), and the blocksync net reactor's
+seal wire kinds (request/response round-trip + sealable-tip status).
+
+The fixture chain carries a mid-chain BLS validator admission (val-tx
+with its proof of possession), so every span here crosses a REAL
+epoch boundary whose valset bytes + PoPs ride the seal stream.
+Pure-python pairings cost ~0.3-1s each, so chain artifacts are
+module-scoped and pivot cadence is kept small.
+"""
+
+import dataclasses
+from concurrent.futures import Future
+
+import pytest
+
+from cometbft_tpu.aggsig.aggregate import (pop_prove, register_pop,
+                                           reset_pop_registry)
+from cometbft_tpu.aggsig.verify import PairingChecker, prepare_full_commit
+from cometbft_tpu.crypto import bls12381 as bls
+from cometbft_tpu.db.kv import MemDB
+from cometbft_tpu.engine.chain_gen import ChainSealSource, generate_chain
+from cometbft_tpu.libs.metrics import Registry
+from cometbft_tpu.libs.metrics_gen import SealsyncMetrics
+from cometbft_tpu.pipeline.cache import SigCache
+from cometbft_tpu.sealsync import SealAdopter, SealProvider
+from cometbft_tpu.sealsync.adopter import AdoptionError
+from cometbft_tpu.sealsync.chain import (SealChainError, SealTuple,
+                                         plan_adoption)
+from cometbft_tpu.sealsync.provider import SealsyncOverloaded
+from cometbft_tpu.state.state import State
+from cometbft_tpu.store.blockstore import BlockStore
+
+JOINER = bls.Bls12381PrivKey.generate(b"\x5e" * 32)
+EPOCH_H = 4  # val-tx lands at height 2 -> the set changes at height 4
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """6-block, 4-validator uniformly-BLS chain with aggregated seals
+    and one mid-chain BLS admission (pk + power + PoP at height 2)."""
+    pk = JOINER.pub_key().bytes_()
+    tx = (b"val:" + pk.hex().encode() + b"!10!"
+          + pop_prove(JOINER).hex().encode())
+    return generate_chain(n_blocks=6, n_validators=4, txs_per_block=1,
+                          chain_id="sealsync-test", seed=5,
+                          key_type="bls12_381", aggregate=True,
+                          val_tx_heights={2: tx}, extra_keys=[JOINER])
+
+
+@pytest.fixture(scope="module")
+def tuples(chain):
+    return ChainSealSource(chain).fetch_seals(1, chain.max_height())
+
+
+def _genesis_vals(chain):
+    reset_pop_registry()  # from_genesis re-registers; tests re-admit
+    return State.from_genesis(chain.genesis).validators
+
+
+def _fresh_adopter(chain, source, **kw):
+    store = BlockStore(MemDB())
+    cache = SigCache(4096)
+    metrics = SealsyncMetrics(Registry())
+    kw.setdefault("tile_size", 2)
+    kw.setdefault("max_skip", 2)
+    adopter = SealAdopter(chain.chain_id, store, source,
+                          cache=cache, checker=PairingChecker("cpu"),
+                          shards=1, metrics=metrics, **kw)
+    return adopter, store, cache, metrics
+
+
+# --- SealTuple wire form -----------------------------------------------------
+
+def test_seal_tuple_roundtrip(tuples):
+    t = tuples[0]
+    assert t.valset is None  # interior, no epoch payload
+    back = SealTuple.decode(t.encode())
+    assert back.height == t.height
+    assert back.header.hash() == t.header.hash()
+    assert back.commit.encode() == t.commit.encode()
+    assert back.valset is None and back.pops == {}
+
+
+def test_seal_tuple_epoch_payload_roundtrip(tuples):
+    t = tuples[EPOCH_H - 1]
+    assert t.valset is not None, "fixture must cross an epoch boundary"
+    assert JOINER.pub_key().bytes_() in t.pops
+    back = SealTuple.decode(t.encode())
+    assert back.valset.hash() == t.valset.hash()
+    assert back.pops == t.pops
+    assert back.valset.hash() == t.header.validators_hash
+
+
+# --- plan_adoption: trust rule + pivot schedule ------------------------------
+
+def test_plan_adoption_clean(chain, tuples):
+    vals = _genesis_vals(chain)
+    plan = plan_adoption(chain.chain_id, 0, vals, tuples, max_skip=2)
+    tip = chain.max_height()
+    assert plan.start == 1 and plan.tip == tip
+    assert tip in plan.pivots                 # tip anchors the chain
+    assert EPOCH_H - 1 in plan.pivots         # outgoing set attests
+    prev = 0
+    for p in plan.pivots:                     # bounded skip
+        assert p - prev <= 2
+        prev = p
+    # epoch continuity: the served set governs from the boundary on
+    assert plan.vals_for[EPOCH_H].hash() != vals.hash()
+    assert plan.vals_for[EPOCH_H].hash() == \
+        tuples[EPOCH_H - 1].header.validators_hash
+    assert JOINER.pub_key().bytes_() in plan.new_pops
+
+
+def test_plan_rejects_non_contiguous(chain, tuples):
+    vals = _genesis_vals(chain)
+    with pytest.raises(SealChainError) as ei:
+        plan_adoption(chain.chain_id, 0, vals,
+                      tuples[:1] + tuples[2:], max_skip=2)
+    assert ei.value.height == 2 and "non-contiguous" in ei.value.reason
+
+
+def test_plan_rejects_wrong_chain_id(chain, tuples):
+    vals = _genesis_vals(chain)
+    with pytest.raises(SealChainError) as ei:
+        plan_adoption("other-chain", 0, vals, tuples, max_skip=2)
+    assert "chain id" in ei.value.reason
+
+
+def test_plan_rejects_broken_header_chain(chain, tuples):
+    vals = _genesis_vals(chain)
+    # rewrite header 2 (and re-point its commit so the tuple is
+    # self-consistent): the SPAN must still fail, at height 3, where
+    # the hash chain no longer links
+    hdr = dataclasses.replace(tuples[1].header, app_hash=b"\x13" * 32)
+    cmt = dataclasses.replace(
+        tuples[1].commit,
+        block_id=dataclasses.replace(tuples[1].commit.block_id,
+                                     hash=hdr.hash()))
+    forged = list(tuples)
+    forged[1] = dataclasses.replace(tuples[1], header=hdr, commit=cmt)
+    with pytest.raises(SealChainError) as ei:
+        plan_adoption(chain.chain_id, 0, vals, forged, max_skip=2)
+    assert ei.value.height == 3
+
+
+def test_plan_rejects_commit_not_sealing_header(chain, tuples):
+    vals = _genesis_vals(chain)
+    cmt = dataclasses.replace(
+        tuples[1].commit,
+        block_id=dataclasses.replace(tuples[1].commit.block_id,
+                                     hash=b"\x66" * 32))
+    forged = list(tuples)
+    forged[1] = dataclasses.replace(tuples[1], commit=cmt)
+    with pytest.raises(SealChainError) as ei:
+        plan_adoption(chain.chain_id, 0, vals, forged, max_skip=2)
+    assert ei.value.height == 2
+    assert "seal this header" in ei.value.reason
+
+
+def test_plan_rejects_epoch_without_valset(chain, tuples):
+    vals = _genesis_vals(chain)
+    forged = list(tuples)
+    forged[EPOCH_H - 1] = dataclasses.replace(
+        tuples[EPOCH_H - 1], valset=None, pops={})
+    with pytest.raises(SealChainError) as ei:
+        plan_adoption(chain.chain_id, 0, vals, forged, max_skip=2)
+    assert ei.value.height == EPOCH_H
+    assert "without valset" in ei.value.reason
+
+
+def test_plan_rejects_wrong_served_valset(chain, tuples):
+    vals = _genesis_vals(chain)
+    # serve the OLD set's bytes at the boundary: hash can't match the
+    # value the predecessor header pinned
+    forged = list(tuples)
+    forged[EPOCH_H - 1] = dataclasses.replace(
+        tuples[EPOCH_H - 1], valset=vals.copy(), pops={})
+    with pytest.raises(SealChainError) as ei:
+        plan_adoption(chain.chain_id, 0, vals, forged, max_skip=2)
+    assert ei.value.height == EPOCH_H
+    assert "valset hash mismatch" in ei.value.reason
+
+
+# --- adopter: end-to-end, forgery, retries, install sink ---------------------
+
+def test_adopt_clean_and_backfill_cache(chain):
+    vals = _genesis_vals(chain)
+    del vals
+    state = State.from_genesis(chain.genesis)
+    adopter, store, cache, metrics = _fresh_adopter(
+        chain, ChainSealSource(chain))
+    tip = chain.max_height()
+    assert adopter.adopt(state) == tip
+    assert store.adopted_tip() == tip
+    for h in range(1, tip + 1):
+        rec = store.load_adopted_seal(h)
+        assert rec is not None
+        assert rec[1].hash() == chain.blocks[h - 1].header.hash()
+    assert int(metrics.seals_adopted.value()) == tip
+    assert int(metrics.pairings_skipped.value()) > 0
+    assert int(metrics.adopted_tip.value()) == tip
+    # no-double-pairing contract: every adopted commit (pivot or
+    # skipped) is a whole-aggregate cache hit the way blocksync's
+    # marshal route would see it on body backfill
+    for h in range(1, tip + 1):
+        vs = chain.valsets[h - 1]
+        seal = prepare_full_commit(
+            chain.chain_id, vs, chain.seen_commits[h - 1],
+            vs.total_voting_power() * 2 // 3, cache=cache)
+        assert seal.status == "ok", f"height {h} would re-pair"
+
+
+def test_adopt_rejects_deep_forgery_then_completes(chain):
+    state = State.from_genesis(chain.genesis)
+    reset_pop_registry()
+    state = State.from_genesis(chain.genesis)
+    tip = chain.max_height()
+    # "bitmap" is the deep forgery: structure-valid, tally passes,
+    # only the pivot pairing can reject it
+    source = ChainSealSource(chain, corrupt_heights={tip: "bitmap"})
+    adopter, store, _cache, metrics = _fresh_adopter(chain, source)
+    assert adopter.adopt(state) == tip
+    assert int(metrics.adoptions_rejected.value()) == 1
+    assert tip in source.banned          # ban -> honest-peer retry
+    assert store.adopted_tip() == tip
+
+
+def test_adopt_fails_after_max_attempts(chain):
+    reset_pop_registry()
+    state = State.from_genesis(chain.genesis)
+    tip = chain.max_height()
+
+    class Stubborn(ChainSealSource):
+        """Every retry lands on another lying provider."""
+
+        def ban(self, height):
+            super().ban(height)
+            self.corrupt[tip] = "sig"
+
+    source = Stubborn(chain, corrupt_heights={tip: "sig"})
+    adopter, store, _cache, metrics = _fresh_adopter(
+        chain, source, max_attempts=2)
+    with pytest.raises(AdoptionError):
+        adopter.adopt(state)
+    assert int(metrics.adoptions_rejected.value()) == 2
+    assert store.adopted_tip() == 0      # nothing installed
+
+
+def test_install_refuses_unsettled_pivots(chain, tuples):
+    vals = _genesis_vals(chain)
+    plan = plan_adoption(chain.chain_id, 0, vals, tuples, max_skip=2)
+    adopter, store, _cache, _m = _fresh_adopter(
+        chain, ChainSealSource(chain))
+    bad = [True] * len(plan.pivots)
+    bad[-1] = False
+    with pytest.raises(AdoptionError):
+        adopter.install_adopted(plan, bad)
+    with pytest.raises(AdoptionError):
+        adopter.install_adopted(plan, [True])  # wrong arity
+    assert store.adopted_tip() == 0
+    assert store.load_adopted_seal(1) is None
+
+
+# --- blockstore: AS: record lifecycle ----------------------------------------
+
+def test_blockstore_adopted_seal_lifecycle(chain, tuples):
+    store = BlockStore(MemDB())
+    t1, t2 = tuples[0], tuples[1]
+    store.save_adopted_seal(t1.height, t1.commit.block_id, t1.header,
+                            t1.commit)
+    assert store.adopted_tip() == 1
+    assert store.height() == 0           # no body, height unmoved
+    bid, hdr, cmt = store.load_adopted_seal(1)
+    assert hdr.hash() == t1.header.hash()
+    assert cmt.encode() == t1.commit.encode()
+    # idempotent rewrite (adoption resume replans the span)
+    store.save_adopted_seal(t1.height, t1.commit.block_id, t1.header,
+                            t1.commit)
+    assert store.adopted_tip() == 1
+    # contiguity against the combined tip
+    t4 = tuples[3]
+    with pytest.raises(ValueError):
+        store.save_adopted_seal(t4.height, t4.commit.block_id,
+                                t4.header, t4.commit)
+    store.save_adopted_seal(t2.height, t2.commit.block_id, t2.header,
+                            t2.commit)
+    assert store.adopted_tip() == 2
+
+
+# --- provider + net reactor --------------------------------------------------
+
+class _FakePeer:
+    def __init__(self, pid="peer0"):
+        self.id = pid
+        self.sent = []
+
+    def try_send(self, ch, raw):
+        self.sent.append((ch, raw))
+        return True
+
+
+def _adopted_store(chain):
+    """A store holding ONLY adopted-seal records (the freshly-adopted
+    laggard that is already a useful provider)."""
+    reset_pop_registry()
+    state = State.from_genesis(chain.genesis)
+    adopter, store, _cache, _m = _fresh_adopter(
+        chain, ChainSealSource(chain))
+    assert adopter.adopt(state) == chain.max_height()
+    return store
+
+
+def test_provider_serves_adopted_records_prefix(chain):
+    store = _adopted_store(chain)
+    prov = SealProvider(store, metrics=SealsyncMetrics(Registry()))
+    assert prov.status() == (store.base(), chain.max_height())
+    out = prov.serve(1, 100)
+    # no state store: the epoch boundary cannot be attested, so the
+    # run must END there (prefix semantics), never serve unverifiable
+    assert [t.height for t in out] == list(range(1, EPOCH_H))
+    assert out[0].commit.encode() == chain.seen_commits[0].encode()
+
+
+def test_provider_sheds_at_inflight_bound(chain):
+    store = _adopted_store(chain)
+    metrics = SealsyncMetrics(Registry())
+    prov = SealProvider(store, max_inflight=0, metrics=metrics)
+    with pytest.raises(SealsyncOverloaded):
+        prov.serve(1, 4)
+    assert int(metrics.serve_sheds.value()) == 1
+
+
+def test_provider_full_span_after_body_backfill(chain):
+    """Blocksync-synced node (bodies + state store): the provider must
+    serve the WHOLE span including the epoch payload, and the served
+    span must satisfy plan_adoption — the provider->planner loop is
+    closed. PoP delivery rides the val-tx execution path."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.engine.blocksync import BlocksyncReactor
+    from cometbft_tpu.engine.chain_gen import LocalChainSource
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import StateStore
+
+    reset_pop_registry()
+    state = State.from_genesis(chain.genesis)  # genesis PoPs
+    app = KVStoreApplication()
+    app.init_chain(chain.chain_id, 1, [], b"")
+    db = MemDB()
+    store, ss = BlockStore(db), StateStore(db)
+    executor = BlockExecutor(app, state_store=ss, block_store=store)
+    reactor = BlocksyncReactor(executor, store, LocalChainSource(chain),
+                               chain.chain_id, tile_size=8, batch_size=0)
+    state = reactor.sync(state)
+    tip = chain.max_height()
+    assert state.last_block_height == tip
+
+    prov = SealProvider(store, state_store=ss)
+    out = prov.serve(1, 100)
+    assert [t.height for t in out] == list(range(1, tip + 1))
+    boundary = out[EPOCH_H - 1]
+    assert boundary.valset is not None
+    # the joiner's PoP arrived via the val-tx (execution registered it)
+    assert JOINER.pub_key().bytes_() in boundary.pops
+    plan = plan_adoption(chain.chain_id, 0, _genesis_vals(chain),
+                         out, max_skip=2)
+    assert plan.tip == tip
+
+
+def test_net_reactor_seal_wire_roundtrip(chain):
+    from cometbft_tpu.engine.reactor import (BLOCKSYNC_CHANNEL,
+                                             BlocksyncNetReactor, _msg,
+                                             _SEAL_REQ, _SEAL_RESP,
+                                             _STATUS_REQ, _STATUS_RESP)
+    from cometbft_tpu.types import proto
+
+    store = _adopted_store(chain)
+    prov = SealProvider(store)
+    server = BlocksyncNetReactor(store, seal_provider=prov)
+    peer = _FakePeer()
+
+    # status response advertises the sealable tip (field 3)
+    server.receive(BLOCKSYNC_CHANNEL, peer, _msg(_STATUS_REQ))
+    kind, body = peer.sent[-1][1][0], peer.sent[-1][1][1:]
+    assert kind == _STATUS_RESP
+    f = proto.parse_fields(body)
+    assert proto.field_int(f, 3, 0) == chain.max_height()
+
+    # seal request -> prefix response, tuples decode identically
+    server.receive(BLOCKSYNC_CHANNEL, peer,
+                   _msg(_SEAL_REQ, proto.f_varint(1, 1)
+                        + proto.f_varint(2, 100)))
+    kind, body = peer.sent[-1][1][0], peer.sent[-1][1][1:]
+    assert kind == _SEAL_RESP
+    f = proto.parse_fields(body)
+    assert proto.field_int(f, 1, 0) == 1
+    served = [SealTuple.decode(b) for b in proto.field_all_bytes(f, 2)]
+    direct = prov.serve(1, 100)
+    assert [t.encode() for t in served] == [t.encode() for t in direct]
+
+    # client side: a SEAL_RESP resolves the pending span future
+    client = BlocksyncNetReactor(BlockStore(MemDB()))
+    fut = Future()
+    client._pending_seals[1] = [fut]
+    client.receive(BLOCKSYNC_CHANNEL, peer, peer.sent[-1][1])
+    tuples_got, pid = fut.result(timeout=1)
+    assert pid == peer.id
+    assert [t.height for t in tuples_got] == [t.height for t in direct]
+
+
+def test_pop_registry_restored_for_other_modules(chain):
+    """Leave the process-global PoP registry in the generated-chain
+    state later modules expect (chain gen registered these at module
+    import; tests above reset freely)."""
+    reset_pop_registry()
+    State.from_genesis(chain.genesis)
+    pk = JOINER.pub_key().bytes_()
+    assert register_pop(pk, pop_prove(JOINER))

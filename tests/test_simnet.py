@@ -270,3 +270,22 @@ def test_bls_valset_scenario():
             "case=forged-bitmap", "case=undercount"} <= equiv
     b = run_scenario("bls-valset", 1, quick=True)
     assert b.digest == a.digest and b.log_lines == a.log_lines
+
+
+def test_seal_adoption_scenario():
+    """Aggregate-seal catch-up (sealsync): both forgery modes reject
+    at the pivot pairing and adoption still completes via the honest
+    retry, the skip schedule elides pairings, backfill is 100% cache
+    hits, and the log is byte-identical across runs of one seed."""
+    a = run_scenario("seal-adoption", 1, quick=True)
+    assert a.ok, a.failure_line()
+    forged = {line.split()[1] for line in a.log_lines
+              if line.startswith("forge ")}
+    assert {"mode=sig", "mode=bitmap"} <= forged
+    assert all("rejected=1" in line for line in a.log_lines
+               if line.startswith("forge "))
+    assert any(line.startswith("backfill cache_hits=")
+               and line.split("=")[1].split("/")[0]
+               == line.split("/")[1] for line in a.log_lines)
+    b = run_scenario("seal-adoption", 1, quick=True)
+    assert b.digest == a.digest and b.log_lines == a.log_lines

@@ -75,6 +75,17 @@ echo "=== bls-valset quick sweep + aggsig A/B smoke ===" >&2
 python tools/sim_run.py --scenario bls-valset --seeds 0..2 --quick || rc=$?
 BENCH_AGG_VALS=20 BENCH_AGG_BLOCKS=2 BENCH_AGG_SAMPLE=2 \
     python bench.py --aggsig || rc=$?
+# sealsync: the seal-adoption sweep pins aggregate-seal catch-up byte-
+# identical per seed — forged seal AND forged bitmap reject at the
+# pivot pairing, adoption completes via the honest peer across an
+# epoch boundary, and backfill re-pairs nothing (every adopted commit
+# a SigCache hit); the bench smoke proves the seal-vs-blocksync A/B
+# still emits (tiny config — the PERF.md datum is the 200-validator
+# run)
+echo "=== seal-adoption quick sweep + sealsync A/B smoke ===" >&2
+python tools/sim_run.py --scenario seal-adoption --seeds 0..4 --quick || rc=$?
+BENCH_SEAL_VALS=16 BENCH_SEAL_BLOCKS=6 \
+    python bench.py --sealsync || rc=$?
 # miller kernel smoke: the real fused Miller + final-exp scan against
 # host math plus the canary-gated PairingChecker arc (slow-marked: one
 # bucket-4 scan compile; suite 1/2's unfiltered run covers it too, but

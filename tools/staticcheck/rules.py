@@ -222,10 +222,13 @@ class ReactorSleepRule(Rule):
     # determinism. trace/: the recorder runs inline under data-plane
     # locks (span end -> record), so a sleep there stalls every
     # instrumented hot path at once
+    # sealsync: the provider serves on reactor threads and the adopter
+    # runs the boot critical path — a sleep in either stalls catch-up
     roots = ("cometbft_tpu/consensus", "cometbft_tpu/pipeline",
              "cometbft_tpu/engine", "cometbft_tpu/farm",
              "cometbft_tpu/ingest", "cometbft_tpu/aggsig",
-             "cometbft_tpu/mesh", "cometbft_tpu/trace")
+             "cometbft_tpu/mesh", "cometbft_tpu/trace",
+             "cometbft_tpu/sealsync")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
@@ -335,10 +338,12 @@ class BareExceptRule(Rule):
     # probe errors are exactly the signals shard quarantine keys off;
     # trace/ sits inline in all of the above — a bare except in the
     # recorder could eat the very exception a dump is documenting
+    # sealsync/'s pairing verdicts gate finality install — a swallowed
+    # checker error there would install unverified finality
     roots = ("cometbft_tpu/device", "cometbft_tpu/pipeline",
              "cometbft_tpu/farm", "cometbft_tpu/ingest",
              "cometbft_tpu/aggsig", "cometbft_tpu/mesh",
-             "cometbft_tpu/trace")
+             "cometbft_tpu/trace", "cometbft_tpu/sealsync")
 
     def check(self, ctx: FileCtx) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):

@@ -90,7 +90,8 @@ GATES = {
 SINK_QUALS = {
     f"{_PKG}.pipeline.cache.SigCache.add",
 }
-SINK_NAMES = {"check_tx", "_apply_one", "save_light_block"}
+SINK_NAMES = {"check_tx", "_apply_one", "save_light_block",
+              "install_adopted"}
 
 
 class _Summary:
