@@ -30,6 +30,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Union
 
+from ..libs import faultio
 from ..types import proto
 from ..types.block import BlockID
 from ..types.vote import Vote, Proposal
@@ -174,9 +175,9 @@ class WAL:
         if os.path.exists(path):
             good = self._scan_good_prefix(path)
             if good != os.path.getsize(path):
-                with open(path, "r+b") as f:
+                with faultio.open_file(path, "r+b", label="wal:head") as f:
                     f.truncate(good)
-        self._f = open(path, "ab")
+        self._f = faultio.open_file(path, "ab", label="wal:head")
 
     # --- group layout ---------------------------------------------------------
 
@@ -202,14 +203,13 @@ class WAL:
         nxt = 0
         if rotated:
             nxt = int(rotated[-1].rsplit(".", 1)[1]) + 1
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        faultio.fsync(self._f)
         self._f.close()
         from ..libs.fail import fail_point
         fail_point("wal:pre-rotate-rename")
         os.rename(self.path, f"{self.path}.{nxt:03d}")
         fail_point("wal:post-rotate-rename")
-        self._f = open(self.path, "ab")
+        self._f = faultio.open_file(self.path, "ab", label="wal:head")
         # total-size enforcement: drop oldest rotated files
         files = self._rotated()
         total = sum(os.path.getsize(p) for p in files + [self.path])
@@ -221,7 +221,7 @@ class WAL:
     @staticmethod
     def _scan_good_prefix(path: str) -> int:
         good = 0
-        with open(path, "rb") as f:
+        with faultio.open_file(path, "rb", label="wal:read") as f:
             while True:
                 hdr = f.read(8)
                 if len(hdr) < 8:
@@ -252,7 +252,7 @@ class WAL:
         #ENDHEIGHT (reference wal.go:83 WriteSync, state.go:825,1890):
         the signature must be durable before it can reach the network."""
         self.write(msg)
-        os.fsync(self._f.fileno())
+        faultio.fsync(self._f)
 
     # --- reads ----------------------------------------------------------------
 
@@ -290,7 +290,7 @@ class WAL:
         WALDecoder's DataCorruptionError posture, wal.go:284)."""
         for path in self._group_files():
             try:
-                f = open(path, "rb")
+                f = faultio.open_file(path, "rb", label="wal:read")
             except FileNotFoundError:
                 continue  # pruned concurrently by total-size enforcement
             with f:
@@ -301,8 +301,27 @@ class WAL:
                     crc, ln = struct.unpack("<II", hdr)
                     payload = f.read(ln)
                     if len(payload) < ln or zlib.crc32(payload) != crc:
-                        return  # corrupt record: end the WHOLE stream
+                        # corrupt record: end the WHOLE stream — but
+                        # LOUDLY. The constructor already repaired any
+                        # torn head tail, so landing here is disk
+                        # damage an operator must hear about, not a
+                        # silent short replay.
+                        self._note_corruption(path, f.tell())
+                        return
                     yield decode_message(payload)
+
+    @staticmethod
+    def _note_corruption(path: str, offset: int) -> None:
+        import sys
+        print(f"WAL corruption: CRC/length-bad record in {path} near "
+              f"offset {offset}; replay truncated at the gap",
+              file=sys.stderr, flush=True)
+        # lazy: consensus/ -> store/ is a runtime-only edge, and this
+        # is a cold disk-damage path
+        from ..store import recovery
+        m = recovery.metrics()
+        if m is not None:
+            m.wal_corruption.inc()
 
     def close(self) -> None:
         self._f.close()

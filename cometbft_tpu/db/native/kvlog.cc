@@ -3,7 +3,16 @@
 // the reference engine, node/node.go:284; record format shared with the
 // pure-Python FileDB in ../kv.py so files are interchangeable).
 //
-// Record: u8 op | u32le klen | u32le vlen | key | value
+// v1 record (written here): u8 op(0|1) | u32le klen | u32le vlen |
+//   key | value — self-committing.
+// v2 record (written by FileDB, docs/STORAGE.md): u8 op(2|3|4) |
+//   u32le klen | u32le vlen | u32le crc | key | value, crc over the
+//   v1-shaped header + key + value. Ops 2/3 buffer until a commit
+//   marker (op 4, value = u32le record count) lands; an uncommitted,
+//   torn, or CRC-bad tail truncates back to the last commit boundary,
+//   mirroring FileDB's all-or-nothing batch replay. This backend keeps
+//   WRITING v1 (each record its own commit point — FileDB replays the
+//   mixed log fine) but must READ v2 so the two stay interchangeable.
 // Open replays the log into an ordered in-memory index (std::map) and
 // truncates a torn tail (crash mid-append). compact() rewrites live
 // records through a temp file + atomic rename.
@@ -29,6 +38,34 @@ namespace {
 
 constexpr uint8_t REC_SET = 0;
 constexpr uint8_t REC_DEL = 1;
+constexpr uint8_t REC_SET2 = 2;
+constexpr uint8_t REC_DEL2 = 3;
+constexpr uint8_t REC_COMMIT = 4;
+
+// zlib-compatible CRC-32 (polynomial 0xEDB88320), table built once —
+// matches Python's zlib.crc32 so FileDB-written records verify here
+const uint32_t* crc_table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+uint32_t crc32_update(uint32_t crc, const void* buf, size_t n) {
+  const uint8_t* p = (const uint8_t*)buf;
+  const uint32_t* t = crc_table();
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) crc = t[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
 
 struct Handle {
   std::map<std::string, std::string> index;
@@ -57,23 +94,66 @@ void wr32(uint8_t* p, uint32_t v) {
   p[2] = (v >> 16) & 0xff; p[3] = (v >> 24) & 0xff;
 }
 
-// replay; returns byte offset of the last complete record
+// replay; returns byte offset of the last COMMITTED byte (end of the
+// last complete v1 record or v2 commit marker — buffered v2 records
+// without their marker are a crashed batch, discarded wholesale)
 long replay(Handle* h, FILE* f) {
-  long good = 0;
-  uint8_t hdr[9];
+  long good = 0, pos = 0;
+  uint8_t hdr[13];
   std::string key, val;
+  std::vector<std::pair<uint8_t, std::pair<std::string, std::string>>>
+      pending;
   for (;;) {
-    if (!read_exact(f, hdr, 9)) break;
-    uint32_t klen = rd32(hdr + 1), vlen = rd32(hdr + 5);
-    key.resize(klen);
-    val.resize(vlen);
-    if (klen && !read_exact(f, &key[0], klen)) break;
-    if (vlen && !read_exact(f, &val[0], vlen)) break;
-    good += 9 + (long)klen + (long)vlen;
-    if (hdr[0] == REC_SET) {
-      h->index[key] = val;
+    if (!read_exact(f, hdr, 1)) break;
+    uint8_t op = hdr[0];
+    if (op == REC_SET || op == REC_DEL) {
+      if (!read_exact(f, hdr + 1, 8)) break;
+      uint32_t klen = rd32(hdr + 1), vlen = rd32(hdr + 5);
+      key.resize(klen);
+      val.resize(vlen);
+      if (klen && !read_exact(f, &key[0], klen)) break;
+      if (vlen && !read_exact(f, &val[0], vlen)) break;
+      if (!pending.empty()) break;  // v1 inside an open v2 batch: corrupt
+      if (op == REC_SET) {
+        h->index[key] = val;
+      } else {
+        h->index.erase(key);
+      }
+      pos += 9 + (long)klen + (long)vlen;
+      good = pos;
+    } else if (op == REC_SET2 || op == REC_DEL2 || op == REC_COMMIT) {
+      if (!read_exact(f, hdr + 1, 12)) break;
+      uint32_t klen = rd32(hdr + 1), vlen = rd32(hdr + 5);
+      uint32_t crc = rd32(hdr + 9);
+      key.resize(klen);
+      val.resize(vlen);
+      if (klen && !read_exact(f, &key[0], klen)) break;
+      if (vlen && !read_exact(f, &val[0], vlen)) break;
+      // crc covers the v1-shaped header (op|klen|vlen) + key + value
+      uint32_t got = crc32_update(0, hdr, 1);
+      got = crc32_update(got, hdr + 1, 8);
+      got = crc32_update(got, key.data(), klen);
+      got = crc32_update(got, val.data(), vlen);
+      if (got != crc) break;
+      pos += 13 + (long)klen + (long)vlen;
+      if (op == REC_COMMIT) {
+        if (klen != 0 || vlen != 4 ||
+            rd32((const uint8_t*)val.data()) != pending.size())
+          break;
+        for (const auto& p : pending) {
+          if (p.first == REC_SET2) {
+            h->index[p.second.first] = p.second.second;
+          } else {
+            h->index.erase(p.second.first);
+          }
+        }
+        pending.clear();
+        good = pos;
+      } else {
+        pending.push_back({op, {key, val}});
+      }
     } else {
-      h->index.erase(key);
+      break;  // unknown op: corrupt tail
     }
   }
   return good;
@@ -102,6 +182,9 @@ void* nkv_open(const char* path, int fsync_each) {
   auto* h = new Handle();
   h->path = path;
   h->fsync_each = fsync_each != 0;
+  // crash hygiene (parity with FileDB): a crash before compact()'s
+  // rename leaves a stale temp beside the log — always stale state
+  remove((h->path + ".compact").c_str());
   FILE* existing = fopen(path, "rb");
   if (existing != nullptr) {
     long good = replay(h, existing);

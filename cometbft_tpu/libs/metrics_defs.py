@@ -209,6 +209,28 @@ METRICS_SPEC = {
         ("gauge", "adopted_tip", "sealsync_adopted_tip",
          "Highest height with adopted (seal-derived) finality", ()),
     ],
+    # storage crash consistency (db/kv.py v2 replay, consensus/wal.py,
+    # store/recovery.py boot doctor): every boot-time repair is
+    # attributed by kind, and disk damage (CRC failures, mid-group WAL
+    # corruption, discarded uncommitted batches) is counted instead of
+    # silently truncating replay (docs/STORAGE.md)
+    "StorageMetrics": [
+        ("counter", "doctor_runs", "storage_doctor_runs",
+         "Boot-time recovery-doctor passes completed", ()),
+        ("counter", "doctor_repairs", "storage_doctor_repairs",
+         "Recovery-doctor repairs applied, by kind (meta-without-parts,"
+         " orphaned-adopted-seal, stale-compact, stale-pv-tmp)",
+         ("kind",)),
+        ("counter", "wal_corruption", "storage_wal_corruption",
+         "Mid-group WAL CRC/length corruption events that truncated "
+         "replay (disk damage, not crash-repair)", ()),
+        ("counter", "torn_batches", "storage_torn_batches",
+         "Uncommitted FileDB batch tails discarded at replay "
+         "(crashed write_batch rolled back all-or-nothing)", ()),
+        ("counter", "crc_failures", "storage_crc_failures",
+         "FileDB v2 records failing CRC at replay (bit-rot detected "
+         "instead of silently replayed)", ()),
+    ],
     # reference mempool/metrics.go
     "MempoolMetrics": [
         ("gauge", "size", "mempool_size",

@@ -110,6 +110,38 @@ class Node:
             retain_abci_responses=not config.storage.discard_abci_responses)
         self._indexer_db = open_db(be, "indexer", ddir)
 
+        # --- boot-time recovery doctor (store/recovery.py) -------------------
+        # Runs BEFORE the handshake and reactors: cross-checks WAL
+        # ENDHEIGHT vs state vs blockstore, repairs crash litter, and
+        # refuses to boot (RecoveryError) on anything unrepairable.
+        # The metrics registry is created here (not with the consensus
+        # metrics below) so doctor repairs — including the ones FileDB
+        # already performed while opening above — are attributed in
+        # StorageMetrics.
+        from ..libs.metrics import Registry
+        self.metrics_registry = Registry()
+        from ..libs.metrics_gen import StorageMetrics
+        from ..store import recovery as _recovery
+        self.storage_metrics = StorageMetrics(self.metrics_registry)
+        if _recovery._metrics is None:  # first node wins, like SigCache
+            _recovery.set_metrics(self.storage_metrics)
+        _wal_doctor = WAL(
+            config.path(config.consensus.wal_file),
+            head_size_limit=config.consensus.wal_head_size_limit,
+            total_size_limit=config.consensus.wal_total_size_limit)
+        try:
+            import sys as _sys
+            self.recovery_report = _recovery.run_doctor(
+                block_store=self.block_store,
+                state_store=self.state_store,
+                wal=_wal_doctor, db_dir=ddir,
+                pv_state_path=config.path(
+                    config.base.priv_validator_file),
+                log=lambda s: print(f"[{config.base.moniker}] {s}",
+                                    file=_sys.stderr))
+        finally:
+            _wal_doctor.close()
+
         # --- state: stored or genesis (node.go:289) --------------------------
         state = self.state_store.load()
         if state is None:
@@ -195,8 +227,9 @@ class Node:
             tx_indexer=self.tx_indexer,
             block_indexer=self.block_indexer)
         self.executor.pruner = self.pruner
-        from ..libs.metrics import ConsensusMetrics, Registry
-        self.metrics_registry = Registry()
+        from ..libs.metrics import ConsensusMetrics
+        # (metrics_registry was created up in the doctor section so
+        # storage repairs during DB open are attributed)
         # mosaic-miscompile canary counters (ops/ed25519._run_canary):
         # trips > 0 means a pallas kernel claimed batch_ok on a batch
         # with a known-invalid lane and was permanently disabled

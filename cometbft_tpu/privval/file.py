@@ -16,11 +16,11 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 from dataclasses import dataclass
 from typing import Optional, Protocol, Tuple
 
 from ..crypto.keys import Ed25519PrivKey, Ed25519PubKey, PubKey
+from ..libs import faultio
 from ..types import proto
 from ..types.vote import Vote, Proposal, PREVOTE_TYPE, PRECOMMIT_TYPE
 
@@ -164,8 +164,9 @@ class FilePV:
 
     @classmethod
     def load(cls, state_path: str) -> "FilePV":
-        with open(state_path, "rb") as f:
-            d = json.load(f)
+        cls._clean_orphan_tmp(state_path)
+        with faultio.open_file(state_path, "rb", label="pv:state") as f:
+            d = json.loads(f.read())
         from ..crypto.keys import privkey_from_type_bytes
         return cls(
             privkey_from_type_bytes(d.get("key_type", "ed25519"),
@@ -180,14 +181,34 @@ class FilePV:
     def load_or_generate(cls, state_path: str) -> "FilePV":
         if os.path.exists(state_path):
             return cls.load(state_path)
+        cls._clean_orphan_tmp(state_path)
         pv = cls.generate(state_path)
         pv._save()
         return pv
 
+    @staticmethod
+    def _clean_orphan_tmp(state_path: str) -> None:
+        """A crash between _save's write and its os.replace orphans
+        `state_path + ".tmp"`. Discarding it is always safe: _save
+        completes (tmp replaced) BEFORE the signature it records is
+        released, so an orphaned — possibly torn — tmp never holds a
+        sign-state the network could have seen. The committed state
+        file stays authoritative; last-sign state never regresses."""
+        tmp = state_path + ".tmp"
+        if os.path.exists(tmp):
+            os.remove(tmp)
+            from ..store import recovery  # lazy: cold repair path
+            m = recovery.metrics()
+            if m is not None:
+                m.doctor_repairs.inc(kind="stale-pv-tmp")
+
     def _save(self) -> None:
         """Atomic write + fsync BEFORE the signature is released — the
         crash-safety half of the double-sign guard (reference
-        privval/file.go:437-447 saveSigned → internal/tempfile)."""
+        privval/file.go:437-447 saveSigned → internal/tempfile). The
+        temp is the fixed `state_path + ".tmp"` (not mkstemp) so a
+        crash between write and replace leaves exactly one orphan the
+        doctor / next load can identify and remove."""
         if self.state_path is None:
             return
         data = json.dumps({
@@ -200,13 +221,13 @@ class FilePV:
             "signature": self.last.signature.hex(),
             "sign_bytes": self.last.sign_bytes.hex(),
         }).encode()
-        d = os.path.dirname(self.state_path) or "."
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".pv-state-")
+        tmp = self.state_path + ".tmp"
+        f = faultio.open_file(tmp, "wb", label="pv:state")
         try:
-            os.write(fd, data)
-            os.fsync(fd)
+            f.write(data)
+            faultio.fsync(f)
         finally:
-            os.close(fd)
+            f.close()
         os.replace(tmp, self.state_path)
 
     # --- PrivValidator interface ---------------------------------------------

@@ -62,12 +62,14 @@ from ..engine.reactor import BlocksyncNetReactor
 from ..evidence.pool import EvidencePool
 from ..evidence.reactor import EvidenceReactor
 from ..libs import fail as libfail
+from ..libs import faultio
 from ..libs import timesource
 from ..mempool.mempool import CListMempool
 from ..mempool.reactor import MempoolReactor
 from ..privval.file import DoubleSignError, FilePV
 from ..state.execution import BlockExecutor
 from ..state.state import GenesisDoc, State, StateStore
+from ..store import recovery as _recovery
 from ..store.blockstore import BlockStore
 from ..types.block import BlockID
 from ..types.proto import Timestamp
@@ -178,8 +180,15 @@ class SimNode:
         self.state_db = MemDB()
         d = os.path.join(workdir, f"node{idx}")
         os.makedirs(d, exist_ok=True)
+        self.dir = d
         self.wal_path = os.path.join(d, "wal")
         self.pv_state_path = os.path.join(d, "pv.json")
+        # scenario knob: db_factory(node, name) -> KVStore. When set
+        # (torn-storage), boot() REOPENS the block/state DBs through it
+        # instead of reusing the in-memory MemDBs — a restart then
+        # exercises the real reopen-replay path (FileDB batch replay,
+        # torn-tail truncation) exactly like a killed process would.
+        self.db_factory = None
         self.crashed = False
         self.booted = False
         self.started = False
@@ -188,8 +197,26 @@ class SimNode:
     def boot(self, sim: "Simulation") -> None:
         """node/node.py boot order, consensus core only."""
         self.app = KVStoreApplication()
+        if self.db_factory is not None:
+            # reopen-replay: fresh handles over the durable files, like
+            # a restarted process would take (FileDB replays the log and
+            # truncates any uncommitted batch tail in its constructor)
+            self.block_db = self.db_factory(self, "blockstore")
+            self.state_db = self.db_factory(self, "state")
         self.block_store = BlockStore(self.block_db)
         self.state_store = StateStore(self.state_db)
+        # boot-time recovery doctor, same slot as node/node.py: after
+        # the stores open, before anything consumes them. The WAL is
+        # built here so the doctor can scan ENDHEIGHT markers; the same
+        # handle is given to ConsensusState below (one open per boot).
+        wal = WAL(self.wal_path)
+        report = _recovery.run_doctor(
+            block_store=self.block_store, state_store=self.state_store,
+            wal=wal, db_dir=self.dir, pv_state_path=self.pv_state_path)
+        if report.count():
+            # deterministic: repair counts are a function of the crash
+            # point, which is a function of (scenario, seed)
+            sim.log("doctor", node=self.idx, repairs=report.count())
         state = self.state_store.load()
         if state is None:
             state = State.from_genesis(self.gen)
@@ -234,7 +261,7 @@ class SimNode:
         idx = self.idx
         self.cs = ConsensusState(
             self.config, state, self.executor, self.block_store,
-            priv_validator=pv, wal=WAL(self.wal_path),
+            priv_validator=pv, wal=wal,
             ticker_cls=sim.ticker_factory(idx), name=str(idx))
         self.cs.evidence_pool = self.evidence_pool
         self.cs.on_commit = sim.commit_hook(idx)
@@ -437,6 +464,18 @@ class Simulation:
             node.cs.wal.close()
         except Exception:  # noqa: BLE001
             pass
+        for db in (node.block_db, node.state_db):
+            try:
+                db.close()
+            except Exception:  # noqa: BLE001
+                pass
+        # fsync-lie semantics: data the OS acknowledged but never made
+        # durable dies with the process — truncate lied files back to
+        # their honest watermark (scope with path_substr so one node's
+        # crash does not eat another's files)
+        plan = faultio.current()
+        if plan is not None:
+            plan.apply_crash()
         restart_ms = self._restart_after.pop(idx, None)
         if restart_ms is not None:
             self.clock.schedule(restart_ms * MS,
@@ -559,10 +598,16 @@ class Simulation:
         finally:
             libfail.clear_fail_hook()
             timesource.reset()
+            faultio.reset()
             for node in self.nodes:
                 if node.booted:
                     try:
                         node.cs.wal.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                for db in (node.block_db, node.state_db):
+                    try:
+                        db.close()
                     except Exception:  # noqa: BLE001
                         pass
             if self._own_workdir:

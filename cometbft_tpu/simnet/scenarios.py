@@ -13,10 +13,12 @@ committee where one byzantine/faulty node is tolerated.
 
 from __future__ import annotations
 
+import os
 from typing import Dict
 
 from ..consensus.reactor import (DATA_CHANNEL, VOTE_CHANNEL, _BLOCK_PART,
                                  _PROPOSAL, _VOTE)
+from ..libs import faultio
 from ..types.block import BlockID
 from ..types.vote import Vote
 from .bls_valset import run_bls_valset as _run_bls_valset
@@ -170,6 +172,27 @@ def _setup_device_corrupt(sim: Simulation) -> None:
     sim.at(3600, lambda: sim.blocksync_join(0))
 
 
+def _setup_torn_storage(sim: Simulation) -> None:
+    # node 2's block/state DBs live on REAL FileDB files, and a seeded
+    # torn-write fault tears its 2nd block-save batch mid-write (the
+    # tear offset is a pure function of the seed). The tear crosses the
+    # faultio:torn-write fail point, which crash_at_label converts into
+    # a modeled crash: the node reboots through the real FileDB
+    # reopen-replay (the uncommitted batch tail truncates — all-or-
+    # nothing), the doctor reconciles, and the chain must reach the
+    # target with the same app hash on all nodes.
+    from ..db.kv import FileDB
+    node = sim.nodes[2]
+    node.db_factory = lambda n, name: FileDB(
+        os.path.join(n.dir, f"{name}.db"))
+    plan = faultio.FaultPlan(seed=sim.seed)
+    plan.torn_write("db:log", nth=2,
+                    path_substr=os.path.join("node2", "blockstore"))
+    faultio.install(plan)
+    sim.crash_at_label(2, faultio.TORN_WRITE_LABEL,
+                       restart_after_ms=1800)
+
+
 def _setup_blocksync_wedge(sim: Simulation) -> None:
     # node 0 joins late and catches up through the PIPELINED blocksync
     # engine whose verify backend never answers (the wedged-TPU-tunnel
@@ -215,6 +238,12 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
              "the real blocksync engine before consensus",
              target_height=6, deadline_ms=120_000,
              setup=_setup_blocksync_lag),
+    Scenario("torn-storage", "node 2 runs on FileDB; a seeded torn "
+             "write shears a block-save batch mid-buffer, the node "
+             "crashes at the tear and reboots through replay + "
+             "truncation + the recovery doctor to the same app hash",
+             target_height=5, deadline_ms=120_000, quick_target=4,
+             setup=_setup_torn_storage),
     Scenario("blocksync-wedge", "late joiner syncs through the pipelined "
              "engine with a hung verify device; the watchdog drains "
              "every tile to the CPU fallback",

@@ -185,6 +185,21 @@ class BlockStore:
             self._db.write_batch(sets)
             self._adopted_tip = new_tip
 
+    def adopted_seal_heights(self) -> list[int]:
+        """Heights with a live AS: record, ascending (the recovery
+        doctor's orphan scan; b";" is b":" + 1, closing the prefix)."""
+        with self._lock:
+            return [int.from_bytes(k[3:], "big")
+                    for k, _ in self._db.iterate(b"AS:", b"AS;")]
+
+    def drop_adopted_seal(self, height: int) -> None:
+        """Remove one AS: record without touching adopted_tip — the
+        doctor's repair for a seal whose body is already canonical
+        (save_block should have deleted it; a pre-v2 crash between
+        batches could strand it)."""
+        with self._lock:
+            self._db.write_batch([], [_h(b"AS:", height)])
+
     def load_adopted_seal(self, height: int
                           ) -> Optional[tuple[BlockID, Header, Commit]]:
         raw = self._db.get(_h(b"AS:", height))

@@ -1,0 +1,173 @@
+"""libs/faultio — the deterministic I/O fault-injection seam.
+
+Every fault must be a pure function of (seed, schedule): same plan,
+same workload => same torn offset / flipped bit / error site, no matter
+what other I/O ran first. And with NO plan installed the seam must hand
+back the raw builtin file object — zero overhead on the production
+path."""
+
+import errno
+import os
+
+import pytest
+
+from cometbft_tpu.libs import fail as libfail
+from cometbft_tpu.libs import faultio
+
+
+@pytest.fixture(autouse=True)
+def _clean_seam():
+    faultio.reset()
+    libfail.clear_fail_hook()
+    yield
+    faultio.reset()
+    libfail.clear_fail_hook()
+
+
+def _read(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# --- seam passthrough -----------------------------------------------------
+
+def test_no_plan_returns_raw_file(tmp_path):
+    f = faultio.open_file(str(tmp_path / "f"), "wb", label="db:log")
+    assert not isinstance(f, faultio.FaultFile)
+    f.write(b"x")
+    f.close()
+
+
+def test_unmatched_label_or_path_returns_raw_file(tmp_path):
+    faultio.install(faultio.FaultPlan()
+                    .torn_write("wal:head")
+                    .enospc("db:log", path_substr="other-node"))
+    f = faultio.open_file(str(tmp_path / "f"), "wb", label="db:log")
+    assert not isinstance(f, faultio.FaultFile)
+    f.write(b"unharmed")
+    f.close()
+
+
+# --- torn writes ----------------------------------------------------------
+
+def test_torn_write_explicit_keep_then_one_shot(tmp_path):
+    p = str(tmp_path / "f")
+    faultio.install(faultio.FaultPlan().torn_write(
+        "db:log", nth=2, keep=3))
+    f = faultio.open_file(p, "wb", label="db:log")
+    f.write(b"aaaa")                      # 1st write: untouched
+    with pytest.raises(faultio.InjectedCrash):
+        f.write(b"bbbbbb")                # 2nd write: tears at byte 3
+    f.close()
+    assert _read(p) == b"aaaa" + b"bbb"
+    # one-shot: the fired rule never re-tears (no crash loops on the
+    # post-restart replay of the same label)
+    f2 = faultio.open_file(p, "ab", label="db:log")
+    f2.write(b"after")
+    f2.flush()
+    f2.close()
+    assert _read(p).endswith(b"after")
+
+
+def test_torn_write_seeded_offset_is_deterministic(tmp_path):
+    def run(name, seed):
+        p = str(tmp_path / name)
+        faultio.install(faultio.FaultPlan(seed=seed).torn_write("db:log"))
+        f = faultio.open_file(p, "wb", label="db:log")
+        with pytest.raises(faultio.InjectedCrash):
+            f.write(bytes(range(64)))
+        f.close()
+        faultio.reset()
+        return _read(p)
+
+    assert run("a", 7) == run("b", 7)      # same seed, same tear
+    assert run("c", 7) != run("d", 8)      # the offset IS the seed's
+    # and the offset matches the documented derivation
+    plan = faultio.FaultPlan(seed=7)
+    want = plan._derive("torn", "db:log", 1).randrange(64)
+    assert len(run("e", 7)) == want
+
+
+def test_torn_write_crosses_the_registered_fail_point(tmp_path):
+    crossed = []
+    libfail.set_fail_hook(crossed.append)
+    faultio.install(faultio.FaultPlan().torn_write("db:log", keep=1))
+    f = faultio.open_file(str(tmp_path / "f"), "wb", label="db:log")
+    with pytest.raises(faultio.InjectedCrash):
+        f.write(b"data")
+    f.close()
+    assert crossed == [faultio.TORN_WRITE_LABEL]
+
+
+# --- ENOSPC ---------------------------------------------------------------
+
+def test_enospc_writes_nothing_then_clears(tmp_path):
+    p = str(tmp_path / "f")
+    faultio.install(faultio.FaultPlan().enospc("db:log"))
+    f = faultio.open_file(p, "wb", label="db:log")
+    with pytest.raises(faultio.InjectedFault) as ei:
+        f.write(b"doomed")
+    assert ei.value.errno == errno.ENOSPC
+    f.flush()
+    assert os.path.getsize(p) == 0        # the failed write left no bytes
+    f.write(b"retry-ok")                  # one-shot: space "freed"
+    f.flush()
+    f.close()
+    assert _read(p) == b"retry-ok"
+
+
+# --- fsync lie + power cut ------------------------------------------------
+
+def test_fsync_lie_apply_crash_truncates_to_honest_watermark(tmp_path):
+    p = str(tmp_path / "f")
+    with open(p, "wb") as f:
+        f.write(b"durable")
+    plan = faultio.FaultPlan().fsync_lie("pv:state")
+    faultio.install(plan)
+    f = faultio.open_file(p, "ab", label="pv:state")
+    assert isinstance(f, faultio.FaultFile)
+    f.write(b"+acked-but-lied")
+    faultio.fsync(f)                      # reports success, syncs nothing
+    f.close()
+    assert _read(p) == b"durable+acked-but-lied"  # OS page cache has it
+    assert plan.apply_crash() == [(p, 7)]          # ...the power cut
+    assert _read(p) == b"durable"
+
+
+# --- bit flip -------------------------------------------------------------
+
+def test_bit_flip_on_nth_read_seeded(tmp_path):
+    p = str(tmp_path / "f")
+    clean = bytes(32)
+    with open(p, "wb") as f:
+        f.write(clean)
+
+    def read_once(seed):
+        faultio.install(faultio.FaultPlan(seed=seed).bit_flip("wal:read"))
+        f = faultio.open_file(p, "rb", label="wal:read")
+        data = f.read()
+        f.close()
+        faultio.reset()
+        return data
+
+    got = read_once(3)
+    assert len(got) == 32 and got != clean
+    # exactly ONE bit differs (plausible-length rot, not truncation)
+    assert sum(bin(b).count("1") for b in got) == 1
+    assert read_once(3) == got            # seed-deterministic
+
+
+# --- env arming -----------------------------------------------------------
+
+def test_env_spec_parse_is_malformed_tolerant():
+    plan = faultio._parse_env_spec(
+        "seed=7;torn@db:log@3@5;enospc@wal:head;bogus@x;torn@@2;"
+        "bitflip@wal:read@notanint;fsynclie@pv:state;;seed=zz")
+    assert plan is not None and plan.seed == 7
+    rules = [(r.kind, r.label, r.nth, r.keep) for r in plan.rules]
+    assert ("torn", "db:log", 3, 5) in rules
+    assert ("enospc", "wal:head", 1, None) in rules
+    assert ("fsynclie", "pv:state", 0, None) in rules
+    assert len(rules) == 3                # the malformed entries dropped
+    assert faultio._parse_env_spec("") is None
+    assert faultio._parse_env_spec("bogus@x;seed=4") is None

@@ -295,6 +295,39 @@ def test_bare_except_negative(tmp_path):
     assert res.findings == []
 
 
+# --- rule: raw-file-io ----------------------------------------------------
+
+def test_raw_file_io_positive(tmp_path):
+    res = lint(tmp_path, {
+        "cometbft_tpu/db/x.py":
+            "f = open('log', 'ab')\n",
+        "cometbft_tpu/consensus/y.py":
+            "import os\n\ndef sync(f):\n    os.fsync(f.fileno())\n",
+        "cometbft_tpu/privval/z.py":
+            "import os\nfd = os.open('s', 0)\n"})
+    assert sorted(names(res)) == [
+        ("raw-file-io", "cometbft_tpu/consensus/y.py"),
+        ("raw-file-io", "cometbft_tpu/db/x.py"),
+        ("raw-file-io", "cometbft_tpu/privval/z.py")]
+
+
+def test_raw_file_io_negative(tmp_path):
+    res = lint(tmp_path, {
+        # the seam is the fix — and it lives OUTSIDE the rule's roots
+        "cometbft_tpu/libs/faultio.py":
+            "def open_file(p, m, label=''):\n    return open(p, m)\n",
+        "cometbft_tpu/store/x.py":
+            "from ..libs import faultio\n"
+            "f = faultio.open_file('log', 'ab', label='db:log')\n"
+            "faultio.fsync(f)\n",
+        # raw open outside the crash-consistent trees is fine
+        "cometbft_tpu/rpc/y.py": "f = open('dump', 'wb')\n",
+        # os.path.* / os.remove are not file-handle I/O
+        "cometbft_tpu/db/z.py":
+            "import os\nos.remove('stale')\nos.path.exists('p')\n"})
+    assert res.findings == []
+
+
 # --- rule: metrics-drift --------------------------------------------------
 
 def _metrics_tree(tmp_path):

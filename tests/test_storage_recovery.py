@@ -1,0 +1,375 @@
+"""Crash-consistent storage: FileDB v2 atomic batches, the v1→v2
+upgrade, WAL/privval crash hygiene, and the boot-time recovery doctor.
+
+The load-bearing property, proven exhaustively here and at scale by
+tools/crash_matrix.py: a write_batch torn at ANY byte offset replays to
+the exact pre-batch state — same keys, same file size — and the resumed
+batch lands cleanly on top."""
+
+import os
+import struct
+import zlib
+
+import pytest
+
+from cometbft_tpu.db import kv
+from cometbft_tpu.db.kv import FileDB, MemDB
+from cometbft_tpu.libs import fail as libfail
+from cometbft_tpu.libs import faultio
+from cometbft_tpu.libs.metrics import Registry
+from cometbft_tpu.libs.metrics_gen import StorageMetrics
+from cometbft_tpu.store import recovery
+from cometbft_tpu.store.recovery import RecoveryError, run_doctor
+
+
+@pytest.fixture(autouse=True)
+def _clean_seam():
+    faultio.reset()
+    libfail.clear_fail_hook()
+    yield
+    faultio.reset()
+    libfail.clear_fail_hook()
+
+
+@pytest.fixture
+def storage_metrics():
+    old = recovery.metrics()
+    m = StorageMetrics(Registry())
+    recovery.set_metrics(m)
+    yield m
+    recovery.set_metrics(old)
+
+
+def _dump(db):
+    return dict(db.iterate())
+
+
+# The exact bytes write_batch([(k1,..),(k2,..)], [k0]) appends: two v2
+# sets, one v2 delete, one commit marker — torn everywhere below.
+_BATCH = (kv._enc2(kv._REC_SET2, b"k1", b"v1v1")
+          + kv._enc2(kv._REC_SET2, b"k2", b"second-value")
+          + kv._enc2(kv._REC_DEL2, b"k0")
+          + kv._enc2(kv._REC_COMMIT, b"", kv._U32.pack(3)))
+
+
+# --- v2 atomic batches ------------------------------------------------------
+
+@pytest.mark.parametrize("keep", range(len(_BATCH)))
+def test_torn_batch_replays_to_pre_batch_state_at_every_offset(
+        tmp_path, keep):
+    p = str(tmp_path / "x.db")
+    db = FileDB(p)
+    db.write_batch([(b"k0", b"base"), (b"pre", b"kept")])
+    db.close()
+    size0 = os.path.getsize(p)
+    pre_state = {b"k0": b"base", b"pre": b"kept"}
+
+    faultio.install(faultio.FaultPlan().torn_write(
+        "db:log", nth=1, keep=keep))
+    db = FileDB(p)
+    with pytest.raises(faultio.InjectedCrash):
+        db.write_batch([(b"k1", b"v1v1"), (b"k2", b"second-value")],
+                       [b"k0"])
+    faultio.reset()
+
+    db = FileDB(p)  # reboot: replay + truncate the uncommitted tail
+    assert _dump(db) == pre_state
+    assert os.path.getsize(p) == size0
+    # the resumed batch lands on the repaired log
+    db.write_batch([(b"k1", b"v1v1"), (b"k2", b"second-value")],
+                   [b"k0"])
+    db.close()
+    db = FileDB(p)
+    assert _dump(db) == {b"k1": b"v1v1", b"k2": b"second-value",
+                         b"pre": b"kept"}
+    db.close()
+
+
+def test_v2_crc_catches_plausible_length_bit_rot(tmp_path,
+                                                 storage_metrics):
+    p = str(tmp_path / "x.db")
+    db = FileDB(p)
+    db.write_batch([(b"key", b"value")])
+    db.close()
+    raw = bytearray(open(p, "rb").read())
+    raw[kv._V2_HDR.size + 3] ^= 0x10     # one bit, inside the value
+    with open(p, "wb") as f:
+        f.write(raw)
+    db = FileDB(p)
+    # the CRC kills the record, the open batch dies with it, and the
+    # truncation leaves a clean (empty) log
+    assert db.get(b"key") is None
+    assert storage_metrics.crc_failures.value() == 1
+    assert os.path.getsize(p) == 0
+    db.close()
+
+
+def test_v1_records_have_no_rot_detection(tmp_path):
+    """The contrast case motivating v2: a v1 record with a flipped
+    value bit replays as gospel."""
+    p = str(tmp_path / "x.db")
+    rec = bytearray(struct.pack("<BII", kv._REC_SET, 3, 5)
+                    + b"key" + b"value")
+    rec[-1] ^= 0x01
+    with open(p, "wb") as f:
+        f.write(rec)
+    db = FileDB(p)
+    assert db.get(b"key") not in (None, b"value")  # silent corruption
+    assert db.needs_upgrade
+    db.close()
+
+
+def test_mixed_v1_v2_replay_and_compact_upgrade(tmp_path):
+    p = str(tmp_path / "x.db")
+    with open(p, "wb") as f:   # a legacy log: two v1 records
+        f.write(struct.pack("<BII", kv._REC_SET, 2, 2) + b"k1" + b"a1")
+        f.write(struct.pack("<BII", kv._REC_SET, 2, 2) + b"k2" + b"a2")
+    db = FileDB(p)
+    assert db.needs_upgrade
+    db.write_batch([(b"k3", b"a3")])     # v2 appends onto a v1 log
+    db.close()
+    db = FileDB(p)
+    assert _dump(db) == {b"k1": b"a1", b"k2": b"a2", b"k3": b"a3"}
+    assert db.needs_upgrade              # the v1 records are still there
+    db.compact()                         # ...until the wholesale rewrite
+    assert not db.needs_upgrade
+    db.close()
+    db = FileDB(p)
+    assert not db.needs_upgrade
+    assert _dump(db) == {b"k1": b"a1", b"k2": b"a2", b"k3": b"a3"}
+    db.close()
+
+
+def test_uncommitted_tail_counts_as_torn_batch(tmp_path,
+                                               storage_metrics):
+    p = str(tmp_path / "x.db")
+    db = FileDB(p)
+    db.write_batch([(b"good", b"data")])
+    db.close()
+    size0 = os.path.getsize(p)
+    with open(p, "ab") as f:   # pending records, commit never landed
+        f.write(kv._enc2(kv._REC_SET2, b"lost", b"batch"))
+    db = FileDB(p)
+    assert _dump(db) == {b"good": b"data"}
+    assert os.path.getsize(p) == size0
+    assert storage_metrics.torn_batches.value() == 1
+    db.close()
+
+
+# --- compact() crash hygiene ------------------------------------------------
+
+class _Boom(Exception):
+    pass
+
+
+@pytest.mark.parametrize("label", ["db:pre-compact-replace",
+                                   "db:post-compact-replace"])
+def test_compact_crash_leaves_recoverable_state(tmp_path, label,
+                                                storage_metrics):
+    p = str(tmp_path / "x.db")
+    db = FileDB(p)
+    db.write_batch([(b"a", b"1"), (b"b", b"2")])
+    db.write_batch([], [b"a"])
+    want = _dump(db)
+
+    def hook(crossed):
+        if crossed == label:
+            raise _Boom(crossed)
+    libfail.set_fail_hook(hook)
+    with pytest.raises(_Boom):
+        db.compact()
+    libfail.clear_fail_hook()
+
+    tmp = p + ".compact"
+    if label == "db:pre-compact-replace":
+        assert os.path.exists(tmp)       # crash before the swap
+    else:
+        assert not os.path.exists(tmp)   # the swap already happened
+    db = FileDB(p)                       # reboot
+    assert not os.path.exists(tmp)       # stale temp swept either way
+    assert _dump(db) == want
+    db.close()
+    if label == "db:pre-compact-replace":
+        assert storage_metrics.doctor_repairs.value(
+            kind="stale-compact") == 1
+
+
+# --- the recovery doctor ----------------------------------------------------
+
+def _built_store(n=5, apply_upto=None):
+    """n blocks saved; the first `apply_upto` (default all) applied —
+    apply_upto=n-1 models the normal crash window."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.engine.chain_gen import generate_chain
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State, StateStore
+    from cometbft_tpu.store.blockstore import BlockStore
+    if apply_upto is None:
+        apply_upto = n
+    chain = generate_chain(n, n_validators=4, txs_per_block=1)
+    app = KVStoreApplication()
+    app.init_chain(chain.chain_id, 1, [], b"")
+    bs, ss = BlockStore(MemDB()), StateStore(MemDB())
+    ex = BlockExecutor(app, state_store=ss, block_store=bs)
+    st = State.from_genesis(chain.genesis)
+    ss.save(st)
+    for h in range(1, n + 1):
+        bs.save_block(chain.blocks[h - 1],
+                      chain.blocks[h - 1].make_part_set(),
+                      chain.seen_commits[h - 1])
+        if h <= apply_upto:
+            st, _ = ex.apply_block(st, chain.block_ids[h - 1],
+                                   chain.blocks[h - 1], verified=True)
+    return chain, bs, ss, st
+
+
+def test_doctor_clean_store_is_a_noop(storage_metrics):
+    _, bs, ss, _ = _built_store(3)
+    report = run_doctor(block_store=bs, state_store=ss)
+    assert report.count() == 0
+    assert report.block_height == 3 and report.state_height == 3
+    assert storage_metrics.doctor_runs.value() == 1
+    assert storage_metrics.doctor_repairs.value() == 0
+
+
+def test_doctor_repairs_meta_without_parts(storage_metrics):
+    _, bs, ss, _ = _built_store(5, apply_upto=4)
+    # a pre-v2 torn save_block: tip meta landed, part bodies did not
+    torn_parts = [k for k, _ in bs._db.iterate(b"P:", b"P;")
+                  if int.from_bytes(k[2:10], "big") == 5]
+    assert torn_parts
+    bs._db.write_batch([], torn_parts)
+    assert bs.load_block_meta(5) is not None and bs.load_block(5) is None
+    report = run_doctor(block_store=bs, state_store=ss)
+    assert report.count("meta-without-parts") == 1
+    assert bs.height() == 4 and bs.load_block_meta(5) is None
+    assert storage_metrics.doctor_repairs.value(
+        kind="meta-without-parts") == 1
+
+
+def test_doctor_drops_orphaned_adopted_seal():
+    chain, bs, ss, _ = _built_store(5)
+    # the AS: record save_block should have deleted (pre-v2 crash
+    # between the seal batch and the body batch)
+    bs.save_adopted_seal(5, chain.block_ids[4], chain.blocks[4].header,
+                         chain.seen_commits[4])
+    assert bs.load_adopted_seal(5) is not None
+    report = run_doctor(block_store=bs, state_store=ss)
+    assert report.count("orphaned-adopted-seal") == 1
+    assert bs.load_adopted_seal(5) is None
+    assert bs.height() == 5              # the canonical body untouched
+
+
+def test_doctor_refuses_state_ahead_of_blocks():
+    from cometbft_tpu.store.blockstore import BlockStore
+    _, _, ss, _ = _built_store(3)
+    with pytest.raises(RecoveryError, match="state store is ahead"):
+        run_doctor(block_store=BlockStore(MemDB()), state_store=ss)
+
+
+def test_doctor_refuses_blocks_far_ahead_of_state():
+    from cometbft_tpu.state.state import State, StateStore
+    chain, bs, _, _ = _built_store(5)
+    ss = StateStore(MemDB())
+    ss.save(State.from_genesis(chain.genesis))   # height 0 vs blocks 5
+    with pytest.raises(RecoveryError, match="more than one ahead"):
+        run_doctor(block_store=bs, state_store=ss)
+
+
+def test_doctor_allows_the_normal_crash_window():
+    _, bs, ss, _ = _built_store(5, apply_upto=4)
+    report = run_doctor(block_store=bs, state_store=ss)
+    assert report.count() == 0
+    assert report.block_height == 5 and report.state_height == 4
+
+
+def test_doctor_refuses_wal_ahead_of_blocks(tmp_path):
+    from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+    _, bs, ss, _ = _built_store(3)
+    wal = WAL(str(tmp_path / "wal"))
+    wal.write_sync(EndHeightMessage(9))
+    with pytest.raises(RecoveryError, match="WAL closed height 9"):
+        run_doctor(block_store=bs, state_store=ss, wal=wal)
+    wal.close()
+
+
+def test_doctor_sweeps_filesystem_litter(tmp_path, storage_metrics):
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    stale = os.path.join(d, "x.db.compact")
+    open(stale, "wb").close()
+    pv = str(tmp_path / "state.json")
+    open(pv + ".tmp", "wb").close()
+    report = run_doctor(db_dir=d, pv_state_path=pv)
+    assert report.count("stale-compact") == 1
+    assert report.count("stale-pv-tmp") == 1
+    assert not os.path.exists(stale) and not os.path.exists(pv + ".tmp")
+    assert storage_metrics.doctor_repairs.value(kind="stale-compact") == 1
+    assert storage_metrics.doctor_repairs.value(kind="stale-pv-tmp") == 1
+
+
+# --- privval ----------------------------------------------------------------
+
+def test_privval_torn_tmp_never_regresses_sign_state(tmp_path,
+                                                     storage_metrics):
+    from cometbft_tpu.privval.file import FilePV
+    from cometbft_tpu.types.vote import Vote
+    p = str(tmp_path / "state.json")
+    pv = FilePV.load_or_generate(p)
+    pv.sign_vote("c", Vote(height=5))
+    committed = open(p, "rb").read()
+
+    faultio.install(faultio.FaultPlan().torn_write("pv:state"))
+    with pytest.raises(faultio.InjectedCrash):
+        pv.sign_vote("c", Vote(height=6))
+    faultio.reset()
+
+    # the tear hit the TEMP file: the committed state is byte-identical
+    # and the network never saw a height-6 signature, so staying at 5
+    # cannot double-sign
+    assert open(p, "rb").read() == committed
+    assert os.path.exists(p + ".tmp")
+    pv2 = FilePV.load(p)
+    assert not os.path.exists(p + ".tmp")
+    assert storage_metrics.doctor_repairs.value(kind="stale-pv-tmp") == 1
+    assert pv2.last.height == 5
+    pv2.sign_vote("c", Vote(height=6))   # the retry signs cleanly
+    assert pv2.last.height == 6
+
+
+# --- WAL --------------------------------------------------------------------
+
+def test_wal_mid_group_corruption_is_counted_and_warned(
+        tmp_path, storage_metrics, capsys):
+    from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+    p = str(tmp_path / "wal")
+    wal = WAL(p, head_size_limit=128)
+    for h in range(1, 31):
+        wal.write_sync(EndHeightMessage(h))
+    wal.close()
+    rotated = sorted(f for f in os.listdir(tmp_path)
+                     if f.startswith("wal."))
+    assert rotated                       # the limit forced rotations
+    victim = str(tmp_path / rotated[0])
+    raw = bytearray(open(victim, "rb").read())
+    raw[8] ^= 0x01                       # payload bit in a sealed file
+    with open(victim, "wb") as f:
+        f.write(raw)
+
+    wal2 = WAL(p, head_size_limit=128)
+    msgs = list(wal2.iter_messages())
+    wal2.close()
+    assert len(msgs) < 30                # the stream ends at the rot
+    assert storage_metrics.wal_corruption.value() == 1
+    assert "WAL corruption" in capsys.readouterr().err
+
+
+# --- the simnet scenario ----------------------------------------------------
+
+def test_torn_storage_scenario_is_deterministic():
+    from cometbft_tpu.simnet.scenarios import run_scenario
+    a = run_scenario("torn-storage", 2, quick=True)
+    b = run_scenario("torn-storage", 2, quick=True)
+    assert a.ok, a.violations
+    assert a.crashes >= 1 and a.restarts >= 1
+    assert a.digest == b.digest

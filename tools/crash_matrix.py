@@ -1,0 +1,355 @@
+"""Crash matrix — prove storage crash-consistency at every registered
+fail point and every torn-write byte offset.
+
+Two phases:
+
+1. **Storage-level sweep** (in-process, exhaustive). A scripted batch
+   workload runs against a real FileDB; an uninterrupted reference run
+   records the state hash after EVERY batch. Then, for each batch and
+   each tear offset (every byte offset of the batch's on-disk image in
+   the full matrix; boundary + seeded offsets with --quick), the run is
+   repeated with a `libs/faultio` plan that shears the write at that
+   offset and crashes. The reopened DB must hash to the EXACT pre-batch
+   state — a batch is all-or-nothing, never prefix-applied — and
+   resuming the remaining batches must reach the byte-identical
+   reference final state. The same phase drives the storage-side fail
+   points directly: `db:pre-compact-replace` / `db:post-compact-replace`
+   (both halves of the compact swap) and `wal:pre-rotate-rename` /
+   `wal:post-rotate-rename` (both halves of the WAL rotation), asserting
+   the reopened store/WAL lost nothing that was committed.
+
+2. **Consensus-path sweep** (simnet). The fail-point registry table in
+   docs/SIMNET.md is parsed, and every label not already pinned by
+   phase 1 (and not on the printed skip list — subsystem labels covered
+   by their own scenarios) gets a deterministic 4-node simulation with
+   `crash_at_label(node 2, label)`: the node must crash at the label,
+   reboot through replay + the recovery doctor, and reach the target
+   height with the same app hash as its uninterrupted peers — the
+   peers ARE the reference run. A registry label that never fires fails
+   the matrix loudly, so new fail points cannot dodge coverage.
+
+Usage:
+  python tools/crash_matrix.py           # full matrix (every offset)
+  python tools/crash_matrix.py --quick   # CI sweep (boundary + seeded
+                                         # offsets, 1 seed per label)
+
+Exit 0 on success; on failure prints a CRASH-MATRIX FAIL line naming
+the (phase, label/offset) cell and exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import random
+import re
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cometbft_tpu.consensus.wal import WAL, EndHeightMessage  # noqa: E402
+from cometbft_tpu.db.kv import FileDB                          # noqa: E402
+from cometbft_tpu.libs import fail as libfail                  # noqa: E402
+from cometbft_tpu.libs import faultio                          # noqa: E402
+
+# Labels pinned by the storage-level phase — no simnet run needed.
+STORAGE_LABELS = {
+    "db:pre-compact-replace", "db:post-compact-replace",
+    "wal:pre-rotate-rename", "wal:post-rotate-rename",
+    "faultio:torn-write",
+}
+
+# Labels whose crash semantics are proven by their OWN harnesses (each
+# reason names the covering suite) — a plain 4-validator consensus run
+# never crosses them, so a simnet sweep here would assert nothing.
+SKIP_LABELS = {
+    "farm:flush": "farm crash tests (tests/test_farm.py) + light-farm",
+    "farm:commit-session": "farm crash tests + light-farm scenario",
+    "ingest:flush": "admission crash tests + flash-crowd scenario",
+    "trace:dump": "trace tests (dumping is never load-bearing)",
+    "pipeline:dispatch": "pipelined blocksync crash tests + "
+                         "blocksync-wedge scenario",
+}
+
+_failures = 0
+
+
+def fail(msg: str) -> None:
+    global _failures
+    _failures += 1
+    print(f"CRASH-MATRIX FAIL {msg}")
+
+
+class MatrixCrash(Exception):
+    """Raised by the fail hook at the label under test — the in-process
+    stand-in for the env modes' os._exit(99)."""
+
+
+def hook_for(label: str):
+    def hook(lbl: str) -> None:
+        if lbl == label:
+            raise MatrixCrash(label)
+    return hook
+
+
+def db_hash(db) -> str:
+    h = hashlib.sha256()
+    for k, v in db.iterate():
+        h.update(len(k).to_bytes(4, "big") + k)
+        h.update(len(v).to_bytes(4, "big") + v)
+    return h.hexdigest()
+
+
+def make_ops(n_ops: int):
+    """Deterministic batch workload shaped like store traffic: multi-
+    record set batches with occasional deletes of live keys."""
+    rng = random.Random(f"crash-matrix:{n_ops}")
+    ops, live = [], []
+    for _ in range(n_ops):
+        sets, deletes = [], []
+        for _ in range(rng.randrange(1, 5)):
+            k = f"key/{rng.randrange(48)}".encode()
+            v = bytes(rng.randrange(256)
+                      for _ in range(rng.randrange(1, 72)))
+            sets.append((k, v))
+            live.append(k)
+        if live and rng.random() < 0.35:
+            deletes.append(rng.choice(live))
+        ops.append((sets, deletes))
+    return ops
+
+
+def reference_run(path: str, ops):
+    """Uninterrupted run: state hash + file size after every batch.
+    prefix_hashes[i] == hash after the first i batches."""
+    db = FileDB(path)
+    hashes = [db_hash(db)]
+    sizes = [os.path.getsize(path)]
+    for sets, deletes in ops:
+        db.write_batch(sets, deletes)
+        hashes.append(db_hash(db))
+        sizes.append(os.path.getsize(path))
+    db.close()
+    return hashes, sizes
+
+
+def torn_cell(workdir: str, ops, hashes, i: int, seed: int,
+              keep) -> None:
+    """One matrix cell: tear batch i at `keep` bytes (None = seeded
+    offset), crash, reopen, assert exact pre-batch state, resume,
+    assert reference final state."""
+    tag = f"torn op={i} seed={seed} keep={keep}"
+    path = os.path.join(workdir, f"torn-{i}-{seed}-{keep}.db")
+    plan = faultio.FaultPlan(seed=seed)
+    plan.torn_write("db:log", nth=i + 1, keep=keep,
+                    path_substr=os.path.basename(path))
+    faultio.install(plan)
+    crossed = []
+    libfail.set_fail_hook(crossed.append)
+    db = None
+    try:
+        db = FileDB(path)
+        for j, (sets, deletes) in enumerate(ops):
+            try:
+                db.write_batch(sets, deletes)
+            except faultio.InjectedCrash:
+                if j != i:
+                    fail(f"{tag}: tore batch {j}, expected {i}")
+                break
+        else:
+            fail(f"{tag}: fault never fired")
+            return
+    finally:
+        faultio.reset()
+        libfail.clear_fail_hook()
+        if db is not None:
+            try:
+                db.close()
+            except Exception:  # noqa: BLE001 — handle state is torn
+                pass
+    if faultio.TORN_WRITE_LABEL not in crossed:
+        fail(f"{tag}: {faultio.TORN_WRITE_LABEL} fail point not crossed")
+    db2 = FileDB(path)
+    got = db_hash(db2)
+    if got != hashes[i]:
+        which = ("prefix-applied batch" if got != hashes[i + 1]
+                 else "torn batch survived whole")
+        fail(f"{tag}: recovered state != pre-batch state ({which})")
+        db2.close()
+        return
+    for sets, deletes in ops[i:]:
+        db2.write_batch(sets, deletes)
+    if db_hash(db2) != hashes[-1]:
+        fail(f"{tag}: resumed run diverged from reference final state")
+    db2.close()
+
+
+def phase_storage_torn(workdir: str, quick: bool) -> int:
+    n_ops = 6 if quick else 10
+    ops = make_ops(n_ops)
+    ref = os.path.join(workdir, "reference.db")
+    hashes, sizes = reference_run(ref, ops)
+    cells = 0
+    for i in range(n_ops):
+        op_len = sizes[i + 1] - sizes[i]
+        if quick:
+            rng = random.Random(f"crash-matrix:offsets:{i}")
+            offsets = sorted({0, 1, op_len // 2, op_len - 1,
+                              rng.randrange(op_len),
+                              rng.randrange(op_len)})
+        else:
+            offsets = range(op_len)
+        for keep in offsets:
+            torn_cell(workdir, ops, hashes, i, seed=0, keep=keep)
+            cells += 1
+        # seeded-offset derivation path (keep=None): the tear offset is
+        # a pure function of (seed, label, nth)
+        for seed in range(2 if quick else 5):
+            torn_cell(workdir, ops, hashes, i, seed=seed, keep=None)
+            cells += 1
+    return cells
+
+
+def phase_storage_failpoints(workdir: str) -> int:
+    ops = make_ops(8)
+    cells = 0
+
+    # --- compact swap: both halves ---------------------------------------
+    for label in ("db:pre-compact-replace", "db:post-compact-replace"):
+        path = os.path.join(workdir, f"compact-{label.split(':')[1]}.db")
+        db = FileDB(path)
+        for sets, deletes in ops:
+            db.write_batch(sets, deletes)
+        href = db_hash(db)
+        libfail.set_fail_hook(hook_for(label))
+        try:
+            db.compact()
+            fail(f"{label}: compact() never crossed the fail point")
+        except MatrixCrash:
+            pass
+        finally:
+            libfail.clear_fail_hook()
+        pre = label == "db:pre-compact-replace"
+        if os.path.exists(path + ".compact") != pre:
+            fail(f"{label}: stale temp {'missing' if pre else 'present'} "
+                 f"after crash")
+        db2 = FileDB(path)
+        if os.path.exists(path + ".compact"):
+            fail(f"{label}: stale temp survived reopen")
+        if db_hash(db2) != href:
+            fail(f"{label}: reopened state != pre-compact state")
+        db2.close()
+        cells += 1
+
+    # --- WAL rotation: both halves ---------------------------------------
+    for label in ("wal:pre-rotate-rename", "wal:post-rotate-rename"):
+        path = os.path.join(workdir, f"wal-{label.split(':')[1]}")
+        wal = WAL(path, head_size_limit=256)
+        libfail.set_fail_hook(hook_for(label))
+        crashed_at = None
+        try:
+            for h in range(1, 200):
+                wal.write_sync(EndHeightMessage(h))
+        except MatrixCrash:
+            crashed_at = h
+        finally:
+            libfail.clear_fail_hook()
+        if crashed_at is None:
+            fail(f"{label}: rotation never crossed the fail point")
+            continue
+        # everything synced BEFORE the crashed write must survive;
+        # the in-flight message was never appended (rotation precedes
+        # the append), so the group replays exactly 1..crashed_at-1
+        wal2 = WAL(path, head_size_limit=256)
+        heights = [m.height for m in wal2.iter_messages()]
+        if heights != list(range(1, crashed_at)):
+            fail(f"{label}: replay after crash lost committed records "
+                 f"(got {len(heights)} of {crashed_at - 1})")
+        for h in range(crashed_at, crashed_at + 6):
+            wal2.write_sync(EndHeightMessage(h))
+        wal2.close()
+        wal3 = WAL(path, head_size_limit=256)
+        heights = [m.height for m in wal3.iter_messages()]
+        if heights != list(range(1, crashed_at + 6)):
+            fail(f"{label}: resumed WAL is not contiguous")
+        wal3.close()
+        cells += 1
+    return cells
+
+
+def registry_labels() -> list:
+    """Parse the fail-point registry table out of docs/SIMNET.md."""
+    doc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "SIMNET.md")
+    with open(doc, encoding="utf-8") as f:
+        text = f.read()
+    section = text.split("### Fail-point registry", 1)[1]
+    section = section.split("##", 1)[0]
+    return re.findall(r"^\| `([^`]+)` \|", section, flags=re.M)
+
+
+def phase_simnet(quick: bool) -> int:
+    from cometbft_tpu.simnet.harness import Scenario, Simulation
+    cells = 0
+    for label in registry_labels():
+        if label in STORAGE_LABELS:
+            continue
+        if label in SKIP_LABELS:
+            print(f"  skip {label}: covered by {SKIP_LABELS[label]}")
+            continue
+        # k=1 for labels crossed every height (crash mid-chain, not at
+        # height 1); k=0 for proposer-turn labels node 2 reaches once
+        k = 1 if label.startswith(("finalize", "apply_block")) else 0
+
+        def setup(sim, label=label, k=k):
+            sim.crash_at_label(2, label, k=k, restart_after_ms=1800)
+        sc = Scenario("crash-matrix", f"crash node 2 at {label}",
+                      target_height=4, deadline_ms=120_000, setup=setup)
+        for seed in range(1 if quick else 3):
+            res = Simulation(sc, seed, quick=quick).run()
+            tag = f"simnet {label} seed={seed}"
+            if res.crashes < 1 or res.restarts < 1:
+                fail(f"{tag}: label never crossed (crashes="
+                     f"{res.crashes}) — cover it or add to SKIP_LABELS")
+            elif not res.ok:
+                fail(f"{tag}: {res.violations[0]}")
+            elif res.errors:
+                fail(f"{tag}: node error {res.errors[0]}")
+            else:
+                print(f"  ok {label} seed={seed} h={res.max_height} "
+                      f"crashes={res.crashes} restarts={res.restarts}")
+            cells += 1
+    return cells
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="boundary+seeded offsets, 1 seed per label")
+    args = ap.parse_args()
+    workdir = tempfile.mkdtemp(prefix="crash-matrix-")
+    try:
+        print("phase 1a: torn-write offset sweep")
+        torn = phase_storage_torn(workdir, args.quick)
+        print(f"  {torn} cells")
+        print("phase 1b: storage fail points (compact swap, WAL rotate)")
+        fps = phase_storage_failpoints(workdir)
+        print(f"  {fps} cells")
+        print("phase 2: consensus-path fail points (simnet)")
+        sims = phase_simnet(args.quick)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    if _failures:
+        print(f"CRASH-MATRIX FAIL total={_failures}")
+        return 1
+    print(f"CRASH-MATRIX OK torn={torn} storage_failpoints={fps} "
+          f"simnet={sims}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,15 @@ echo "=== trace_view selftest + trace-determinism sweep ===" >&2
 python tools/trace_view.py --selftest || rc=$?
 python -m pytest tests/test_trace.py -q \
     -k "deterministic or byte_identical" || rc=$?
+# crash-consistent storage: the crash matrix tears a FileDB batch at
+# seeded byte offsets (boundary + interior) and crashes at every
+# registered storage fail point, asserting replay recovers the exact
+# pre-batch state (full sweep = every offset; docs/STORAGE.md); the
+# torn-storage sweep pins the same property end-to-end through a live
+# node's save_block + reboot + recovery doctor, byte-identical per seed
+echo "=== crash matrix (quick) + torn-storage quick sweep ===" >&2
+python tools/crash_matrix.py --quick || rc=$?
+python tools/sim_run.py --scenario torn-storage --seeds 0..4 --quick || rc=$?
 # suite 2/2 already covers the slow-marked pipeline soak on a default
 # (unfiltered) run; this explicit step guarantees the depth sweep even
 # when the caller filtered the main suites (e.g. -m 'not slow'), so no

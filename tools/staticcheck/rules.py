@@ -354,6 +354,46 @@ class BareExceptRule(Rule):
                     "(BaseException swallowing hides wedge signals)")
 
 
+class RawFileIoRule(Rule):
+    """The crash-consistent stores (db/, consensus WAL, store/,
+    privval/) do all file I/O through libs/faultio.open_file /
+    faultio.fsync so the crash matrix can shear any write at any byte
+    offset deterministically. A raw builtin open() or os.fsync() in
+    those trees is a hole in the fault-injection seam: the write it
+    performs can never be torn under test, so its crash behavior ships
+    unproven."""
+    name = "raw-file-io"
+    doc = ("direct open()/os.open()/os.fdopen()/os.fsync() in "
+           "consensus/, db/, store/, or privval/ — route through "
+           "libs/faultio.open_file()/fsync() so the crash matrix can "
+           "tear the write")
+    roots = ("cometbft_tpu/consensus", "cometbft_tpu/db",
+             "cometbft_tpu/store", "cometbft_tpu/privval")
+
+    _OS_FNS = {"open", "fdopen", "fsync", "fdatasync"}
+
+    def check(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "open":
+                yield ctx.finding(
+                    self.name, node,
+                    "builtin open() bypasses the faultio seam — use "
+                    "faultio.open_file(path, mode, label=...)")
+            elif isinstance(fn, ast.Attribute) \
+                    and fn.attr in self._OS_FNS \
+                    and _module_of(ctx, fn.value) == "os":
+                repl = ("faultio.fsync(f)"
+                        if fn.attr in ("fsync", "fdatasync")
+                        else "faultio.open_file(...)")
+                yield ctx.finding(
+                    self.name, node,
+                    f"os.{fn.attr}() bypasses the faultio seam — "
+                    f"use {repl}")
+
+
 class MetricsDriftRule(Rule):
     """libs/metrics_gen.py is generated from libs/metrics_defs.py;
     hand-edits or un-regenerated spec changes drift the Prometheus
@@ -389,4 +429,4 @@ class MetricsDriftRule(Rule):
 ALL_RULES = [WallClockRule, GlobalRngRule, RawEnvRule, ReactorSleepRule,
              GuardedByRule, FailPointRule, BareExceptRule,
              MetricsDriftRule, LockOrderRule, VerdictTaintRule,
-             KernelDisciplineRule]
+             KernelDisciplineRule, RawFileIoRule]
