@@ -153,10 +153,13 @@ class DeviceClient:
         tctx = ctx_of(ctx)
         trailer = tctx.to_wire() if tctx is not None else None
         req_id = next(self._ids)
-        fut = DeviceFuture(self, req_id, len(pubs))
         with self._wlock:
+            # check the link BEFORE minting the future: a future that
+            # exists when the refusal raises is an orphan nothing can
+            # ever resolve
             if self._dead is not None:
                 raise ConnectionError(f"device link down: {self._dead}")
+            fut = DeviceFuture(self, req_id, len(pubs))
             self._pending[req_id] = fut._ev
             try:
                 send_frame(self._sock, encode_request(req_id, pubs,

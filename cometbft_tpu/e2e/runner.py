@@ -145,13 +145,16 @@ class Testnet:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env.update(self.base_env)
         env.update(extra_env or {})
-        log = open(node.log_path, "ab")
-        node.proc = subprocess.Popen(
-            [sys.executable, "-m", "cometbft_tpu.cmd.main", "start",
-             "--home", node.home],
-            stdout=log, stderr=log, env=env,
-            cwd=os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))))
+        # Popen dups the descriptor into the child; closing the
+        # parent's handle right after spawn leaks nothing and the
+        # child keeps appending
+        with open(node.log_path, "ab") as log:
+            node.proc = subprocess.Popen(
+                [sys.executable, "-m", "cometbft_tpu.cmd.main", "start",
+                 "--home", node.home],
+                stdout=log, stderr=log, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))))
 
     def start(self) -> None:
         for node in self.nodes:

@@ -206,6 +206,7 @@ class CListMempool:
     # --- post-commit update --------------------------------------------------
 
     def lock(self) -> None:
+        # staticcheck: allow(resource-lifecycle)  ## exported lock()/unlock() pair — the caller brackets app.commit()+update() across statements (reference clist_mempool.go Lock/Unlock); pairing is the caller's contract, pinned by test_mempool
         self._update_lock.acquire()
 
     def unlock(self) -> None:

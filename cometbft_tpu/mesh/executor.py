@@ -356,6 +356,9 @@ class MeshExecutor:
             self._q.put_nowait((fut, list(pubs), list(msgs), list(sigs),
                                 ctx))
         except queue.Full:
+            # the enqueue failed, so nothing will ever resolve this
+            # future — close it out before walking away
+            fut.cancel()
             raise MeshOverloaded(
                 f"mesh dispatch queue full "
                 f"({self._q.maxsize} tiles)") from None

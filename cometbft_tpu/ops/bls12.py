@@ -228,7 +228,9 @@ def _unpack_tree(arr: jnp.ndarray):
 
 @functools.lru_cache(maxsize=None)
 def _compiled(bucket: int, bits: Tuple[int, ...]):
+    # staticcheck: assume(bucket, 1, 64)
     def run(arr):
+        # staticcheck: assume(arr, 0, 65535, shape=(6, 2, 24, B), dtype=int32)
         return _is_one_mont(pow_bits(_unpack_tree(arr), bits))
     return jax.jit(run)
 
@@ -517,7 +519,9 @@ def miller_scan(lines: jnp.ndarray):
 
 @functools.lru_cache(maxsize=None)
 def _compiled_miller(bucket: int):
+    # staticcheck: assume(bucket, 1, 64)
     def run(lines):
+        # staticcheck: assume(lines, 0, 65535, shape=(S, 2, 2, 3, 2, 24, B), dtype=int32)
         m = _unpack_tree(miller_scan(lines))
         easy = final_exp_easy_j(m)
         return _is_one_mont(pow_bits(easy, HARD_BITS))

@@ -37,6 +37,11 @@ from ..crypto import ref_ed25519 as ref
 def verify_core(pub: jnp.ndarray, sig: jnp.ndarray,
                 hblocks: jnp.ndarray, hnblocks: jnp.ndarray,
                 zip215: bool = True) -> jnp.ndarray:
+    # staticcheck: assume(pub, 0, 255, shape=(N, 32), dtype=uint8)
+    # staticcheck: assume(sig, 0, 255, shape=(N, 64), dtype=uint8)
+    # staticcheck: assume(hblocks, 0, 255, shape=(N, B, 128), dtype=uint8)
+    # staticcheck: assume(hnblocks, 1, 32767, shape=(N,), dtype=int32)
+    # staticcheck: assume(B, 1, 4096)
     """Core batched verify (trace-through form — used directly inside
     shard_map by parallel.verify; jitted entry below).
 
@@ -78,6 +83,12 @@ ZWIN = 32  # radix-16 windows covering the 128-bit random coefficients
 def verify_rlc_core(pub: jnp.ndarray, sig: jnp.ndarray,
                     hblocks: jnp.ndarray, hnblocks: jnp.ndarray,
                     z: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    # staticcheck: assume(pub, 0, 255, shape=(N, 32), dtype=uint8)
+    # staticcheck: assume(sig, 0, 255, shape=(N, 64), dtype=uint8)
+    # staticcheck: assume(hblocks, 0, 255, shape=(N, B, 128), dtype=uint8)
+    # staticcheck: assume(hnblocks, 1, 32767, shape=(N,), dtype=int32)
+    # staticcheck: assume(B, 1, 4096)
+    # staticcheck: assume(z, 0, 65535, shape=(N, 8), dtype=int32)
     """Random-linear-combination batch verify — ONE combined equation for
     the whole tile (the batch equation curve25519-voi evaluates with a
     Pippenger MSM, reference crypto/ed25519/ed25519.go:239-241 →
@@ -179,6 +190,12 @@ def verify_rlc_core_pallas(pub: jnp.ndarray, sig: jnp.ndarray,
                            z: jnp.ndarray,
                            interpret: bool = False
                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    # staticcheck: assume(pub, 0, 255, shape=(N, 32), dtype=uint8)
+    # staticcheck: assume(sig, 0, 255, shape=(N, 64), dtype=uint8)
+    # staticcheck: assume(hblocks, 0, 255, shape=(N, B, 128), dtype=uint8)
+    # staticcheck: assume(hnblocks, 1, 32767, shape=(N,), dtype=int32)
+    # staticcheck: assume(B, 1, 4096)
+    # staticcheck: assume(z, 0, 65535, shape=(N, 8), dtype=int32)
     """`verify_rlc_core` with the dominant point stage (window tables +
     digit selects + lane trees) in a fused Pallas kernel
     (ops/pallas_verify.rlc_window_sums) that keeps every point

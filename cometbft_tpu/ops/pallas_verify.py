@@ -276,6 +276,8 @@ def _pt_add_kernel(c_ref, p_ref, q_ref, o_ref):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pt_add_tiled(p: jnp.ndarray, q: jnp.ndarray,
                  interpret: bool = False) -> jnp.ndarray:
+    # staticcheck: assume(p, 0, 65535, shape=(4, 16, N), dtype=int32)
+    # staticcheck: assume(q, 0, 65535, shape=(4, 16, N), dtype=int32)
     """Complete addition of (4, 16, N) packed points, N % TILE == 0."""
     n = p.shape[-1]
     grid = (n // TILE,)
@@ -349,6 +351,10 @@ def _rlc_kernel(c_ref, a_ref, r_ref, tdig_ref, zdig_ref, o_ref,
 def rlc_window_sums_impl(a_pt: jnp.ndarray, r_pt: jnp.ndarray,
                          t_dig: jnp.ndarray, z_dig: jnp.ndarray,
                          interpret: bool = False) -> jnp.ndarray:
+    # staticcheck: assume(a_pt, 0, 65535, shape=(4, 16, N), dtype=int32)
+    # staticcheck: assume(r_pt, 0, 65535, shape=(4, 16, N), dtype=int32)
+    # staticcheck: assume(t_dig, 0, 15, shape=(64, N), dtype=int32)
+    # staticcheck: assume(z_dig, 0, 15, shape=(32, N), dtype=int32)
     """Per-tile window partial sums for the RLC equation.
 
     a_pt, r_pt: (4, 16, N) packed -A / -R points (already negated,
@@ -404,6 +410,7 @@ def _decompress_kernel(c_ref, b_ref, pt_ref, ok_ref):
 
 def pt_decompress_tiled_impl(enc: jnp.ndarray,
                              interpret: bool = False):
+    # staticcheck: assume(enc, 0, 255, shape=(32, N), dtype=int32)
     """ZIP-215 decompression of (32, N) byte-leading encodings on lane
     tiles (the pallas analog of edwards.pt_decompress — 2x 12.4ms per
     RLC verify on the chip via XLA, docs/PERF.md). Returns
@@ -495,6 +502,9 @@ def _epilogue_kernel(c_ref, w_ref, btab_ref, sdig_ref, ok_ref):
 def rlc_epilogue_impl(folded: jnp.ndarray, b_tab: jnp.ndarray,
                       s_dig: jnp.ndarray,
                       interpret: bool = False) -> jnp.ndarray:
+    # staticcheck: assume(folded, 0, 65535, shape=(4, 16, 96, M), dtype=int32)
+    # staticcheck: assume(b_tab, 0, 65535, shape=(16, 4, 16), dtype=int32)
+    # staticcheck: assume(s_dig, 0, 15, shape=(64,), dtype=int32)
     """folded: (4, 16, 96, M) window partials (M = G*TAIL lanes);
     b_tab: (16, 4, 16) shared [j]B table; s_dig: (64,) radix-16 digits
     of S = sum(z_i s_i). Returns the scalar batch verdict (bool)."""

@@ -125,6 +125,17 @@ class LocalAsyncBackend:
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=2.0)
+        # fail queued-but-undispatched work: a caller blocked in
+        # result() with no timeout would otherwise hang forever on a
+        # future the (now stopped) worker will never resolve
+        while True:
+            try:
+                fut, _pubs, _msgs, _sigs = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError("verify backend closed"))
 
 
 class ReconnectBlocked(health.AccountedTransportError):
@@ -650,6 +661,15 @@ class PipelinedBlocksync:
                     barrier = False
                     spec_vals = state.validators
                     next_start = state.last_block_height + 1
+        except BaseException:
+            # an escape with tiles still speculated (a _settle crash, a
+            # SyncStalled with nothing applied) must not strand their
+            # dispatches — cancel so the device client drops the
+            # answers instead of retaining them for nobody
+            for t in inflight:
+                self._cancel(t)
+            inflight.clear()
+            raise
         finally:
             self._inflight_gauge(0)
         return state

@@ -1,0 +1,513 @@
+"""staticcheck v3 — the interval abstract interpreter (kernel-interval)
+plus the resource-lifecycle and exception-contract families, and the v3
+runner satellites (SARIF emitter, interval_fuzz shadow backend).
+
+Every family gets positive AND negative fixtures on a scratch tree
+(the rule must both catch the seeded defect and accept the corrected
+shape), the assume() pragma contract is pinned on all four outcomes
+(verified / contradicted / stale / missing), and the acceptance goldens
+live here: the real ops/ tree proves the int32 no-overflow contract
+with an EMPTY baseline over >= 124 jit-reachable functions, and the
+real tree is clean under the lifecycle and contract rules.
+
+Stdlib-only imports at module level: this module must stay cheap to
+collect (tier-1 collects the whole suite up front); numpy and the fuzz
+harness are imported inside the tests that need them.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.staticcheck import run_checks  # noqa: E402
+from tools.staticcheck import rules as R  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+
+
+def lint(tmp_path, files, rules):
+    """Full-pipeline lint (tree rules ON — all three v3 families need
+    the project graph / interpreter pass). Baseline defaults to empty;
+    stale-pragma audit findings ride along in .findings."""
+    write_tree(tmp_path, files)
+    return run_checks(str(tmp_path), tree_rules=True, rules=rules)
+
+
+def by_rule(result, rule_name):
+    return [f for f in result.findings if f.rule == rule_name]
+
+
+# --- kernel-interval: the abstract interpreter ----------------------------
+
+def test_interval_escape_positive(tmp_path):
+    """x in [0, 65535] => x*x reaches 4294836225 > 2**31-1: the
+    multiply itself is the int32 escape."""
+    res = lint(tmp_path, {"cometbft_tpu/ops/k.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def square(x):\n"
+        "    # staticcheck: assume(x, 0, 65535, shape=(8,),"
+        " dtype=int32)\n"
+        "    return x * x\n")}, rules=[R.KernelIntervalRule])
+    found = by_rule(res, "kernel-interval")
+    assert len(found) == 1, [f.render() for f in res.findings]
+    assert "int32-escape" in found[0].message
+    assert "4294836225" in found[0].message
+
+
+def test_interval_bounded_negative(tmp_path):
+    """The same shape with intervals that fit is proven clean — and
+    the proof consumes both assume() pragmas (no stale audit)."""
+    res = lint(tmp_path, {"cometbft_tpu/ops/k.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def bounded_add(x, y):\n"
+        "    # staticcheck: assume(x, 0, 1000000, shape=(8,),"
+        " dtype=int32)\n"
+        "    # staticcheck: assume(y, 0, 1000000, shape=(8,),"
+        " dtype=int32)\n"
+        "    return x + y\n")}, rules=[R.KernelIntervalRule])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_interval_scan_carry_widening(tmp_path):
+    """A lax.scan whose carry is re-masked every step converges under
+    widening (clean); dropping the mask makes the carry interval
+    diverge to the int32 rail — the escape must be reported."""
+    masked = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def _step(carry, x):\n"
+        "    nxt = (carry + x) & 0xFFFF\n"
+        "    return nxt, nxt\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def masked_cumsum(xs):\n"
+        "    # staticcheck: assume(xs, 0, 65535, shape=(16, 8),"
+        " dtype=int32)\n"
+        "    carry = jnp.zeros((8,), jnp.int32)\n"
+        "    _, ys = jax.lax.scan(_step, carry, xs)\n"
+        "    return ys\n")
+    res = lint(tmp_path, {"cometbft_tpu/ops/k.py": masked},
+               rules=[R.KernelIntervalRule])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+    runaway = masked.replace("(carry + x) & 0xFFFF", "carry + x")
+    res = lint(tmp_path / "b", {"cometbft_tpu/ops/k2.py": runaway},
+               rules=[R.KernelIntervalRule])
+    found = by_rule(res, "kernel-interval")
+    assert found and "int32-escape" in found[0].message, \
+        [f.render() for f in res.findings]
+
+
+def test_interval_assume_checked_not_trusted(tmp_path):
+    """A mid-body assume() is an obligation: computed [200, 300]
+    against assume(y, 0, 100) is disjoint — contradiction finding.
+    The subset case (y = x >> 1 in [0, 50] vs assume [0, 100]) is
+    proven and consumes the pragma."""
+    res = lint(tmp_path, {"cometbft_tpu/ops/k.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def shifted(x):\n"
+        "    # staticcheck: assume(x, 0, 100, shape=(8,),"
+        " dtype=int32)\n"
+        "    y = x + 200\n"
+        "    # staticcheck: assume(y, 0, 100)\n"
+        "    return y\n")}, rules=[R.KernelIntervalRule])
+    found = by_rule(res, "kernel-interval")
+    assert len(found) == 1, [f.render() for f in res.findings]
+    assert "assume-contradiction" in found[0].message
+
+    res = lint(tmp_path / "b", {"cometbft_tpu/ops/k2.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def halved(x):\n"
+        "    # staticcheck: assume(x, 0, 100, shape=(8,),"
+        " dtype=int32)\n"
+        "    y = x >> 1\n"
+        "    # staticcheck: assume(y, 0, 100)\n"
+        "    return y\n")}, rules=[R.KernelIntervalRule])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_interval_stale_assume_audited(tmp_path):
+    """An assume() in a function the interpreter never reaches is dead
+    weight — the stale-pragma audit flags it (an unchecked assume is
+    an unreviewed trust grant)."""
+    res = lint(tmp_path, {"cometbft_tpu/ops/k.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def live(x):\n"
+        "    # staticcheck: assume(x, 0, 100, shape=(8,),"
+        " dtype=int32)\n"
+        "    return x + 1\n"
+        "\n"
+        "\n"
+        "def dead_helper(z):\n"
+        "    # staticcheck: assume(z, 0, 100, shape=(8,),"
+        " dtype=int32)\n"
+        "    return z + 1\n")}, rules=[R.KernelIntervalRule])
+    stale = by_rule(res, "stale-pragma")
+    assert len(stale) == 1, [f.render() for f in res.findings]
+    assert "stale assume(z" in stale[0].message
+    assert by_rule(res, "kernel-interval") == []
+
+
+def test_interval_unseeded_entry_is_a_hole(tmp_path):
+    """A jit entry parameter with no assume() pragma means the proof
+    cannot start — that hole is itself a finding, not silence."""
+    res = lint(tmp_path, {"cometbft_tpu/ops/k.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def mystery(x):\n"
+        "    return x + 1\n")}, rules=[R.KernelIntervalRule])
+    found = by_rule(res, "kernel-interval")
+    assert found and "entry-precondition" in found[0].message
+    assert "`x` lacks an assume()" in found[0].message
+
+
+# --- resource-lifecycle ---------------------------------------------------
+
+def test_lifecycle_future_leak_positive_and_drained_negative(tmp_path):
+    """A submit() future abandoned on a raise path is flagged (the
+    MeshExecutor queue-full shape); cancel-before-raise is clean."""
+    leaky = (
+        "class Pool:\n"
+        "    def dispatch(self, work):\n"
+        "        fut = self.executor.submit(work)\n"
+        "        if self.closed:\n"
+        "            raise RuntimeError('closed')\n"
+        "        return fut\n")
+    res = lint(tmp_path, {"cometbft_tpu/svc/pool.py": leaky},
+               rules=[R.ResourceLifecycleRule])
+    found = by_rule(res, "resource-lifecycle")
+    assert len(found) == 1, [f.render() for f in res.findings]
+    assert "abandoned on this raise path" in found[0].message
+
+    drained = leaky.replace(
+        "            raise",
+        "            fut.cancel()\n            raise")
+    res = lint(tmp_path / "b", {"cometbft_tpu/svc/pool2.py": drained},
+               rules=[R.ResourceLifecycleRule])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_lifecycle_discarded_submit(tmp_path):
+    res = lint(tmp_path, {"cometbft_tpu/svc/pool.py": (
+        "class Pool:\n"
+        "    def fire_and_forget(self, work):\n"
+        "        self.executor.submit(work)\n")},
+        rules=[R.ResourceLifecycleRule])
+    found = by_rule(res, "resource-lifecycle")
+    assert found and "submit() result discarded" in found[0].message
+
+
+def test_lifecycle_shutdown_drain(tmp_path):
+    """A class whose submit() parks futures in self._q owns them:
+    close() must fail the queued-but-undispatched items or a caller
+    blocked in result() hangs forever."""
+    no_drain = (
+        "import queue\n"
+        "\n"
+        "\n"
+        "class VerifyFuture:\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._q = queue.Queue()\n"
+        "\n"
+        "    def submit(self, item):\n"
+        "        fut = VerifyFuture()\n"
+        "        self._q.put((item, fut))\n"
+        "        return fut\n"
+        "\n"
+        "    def close(self):\n"
+        "        self._stop = True\n")
+    res = lint(tmp_path, {"cometbft_tpu/svc/q.py": no_drain},
+               rules=[R.ResourceLifecycleRule])
+    found = by_rule(res, "resource-lifecycle")
+    assert found and "never fails the queued" in found[0].message
+
+    drains = no_drain.replace(
+        "        self._stop = True\n",
+        "        self._stop = True\n"
+        "        while True:\n"
+        "            try:\n"
+        "                _item, fut = self._q.get_nowait()\n"
+        "            except queue.Empty:\n"
+        "                break\n"
+        "            fut.set_exception(RuntimeError('closed'))\n")
+    res = lint(tmp_path / "b", {"cometbft_tpu/svc/q2.py": drains},
+               rules=[R.ResourceLifecycleRule])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_lifecycle_lock_and_open_discipline(tmp_path):
+    """Bare acquire() without try/finally release() is flagged; the
+    with-statement and try/finally shapes are clean. Raw open()
+    outside a with-item is flagged; the managed shape is clean."""
+    res = lint(tmp_path, {"cometbft_tpu/svc/held.py": (
+        "def bad_lock(self):\n"
+        "    self._lock.acquire()\n"
+        "    self.n += 1\n"
+        "    self._lock.release()\n"
+        "\n"
+        "\n"
+        "def good_with(self):\n"
+        "    with self._lock:\n"
+        "        self.n += 1\n"
+        "\n"
+        "\n"
+        "def good_finally(self):\n"
+        "    self._lock.acquire()\n"
+        "    try:\n"
+        "        self.n += 1\n"
+        "    finally:\n"
+        "        self._lock.release()\n"
+        "\n"
+        "\n"
+        "def bad_open(path):\n"
+        "    fh = open(path)\n"
+        "    data = fh.read()\n"
+        "    fh.close()\n"
+        "    return data\n"
+        "\n"
+        "\n"
+        "def good_open(path):\n"
+        "    with open(path) as fh:\n"
+        "        return fh.read()\n")},
+        rules=[R.ResourceLifecycleRule])
+    found = by_rule(res, "resource-lifecycle")
+    assert len(found) == 2, [f.render() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "without a try/finally" in msgs
+    assert "open() outside a context manager" in msgs
+
+
+def test_lifecycle_allow_pragma_suppresses(tmp_path):
+    """The exported lock()/unlock() pair seam (mempool shape) carries
+    an allow() pragma: suppressed, counted, and NOT stale."""
+    res = lint(tmp_path, {"cometbft_tpu/svc/seam.py": (
+        "class M:\n"
+        "    def lock(self):\n"
+        "        # staticcheck: allow(resource-lifecycle)"
+        "  ## caller brackets commit()+update()\n"
+        "        self._update_lock.acquire()\n"
+        "\n"
+        "    def unlock(self):\n"
+        "        self._update_lock.release()\n")},
+        rules=[R.ResourceLifecycleRule])
+    assert res.findings == [], [f.render() for f in res.findings]
+    assert res.suppressed == 1
+
+
+# --- exception-contract ---------------------------------------------------
+
+def test_contract_undocumented_escape_positive(tmp_path):
+    """A documented seam (sealsync.chain.plan_adoption promises
+    SealChainError) raising some other project-typed error is a
+    contract break."""
+    res = lint(tmp_path, {"cometbft_tpu/sealsync/chain.py": (
+        "class SealChainError(Exception):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "class WireGlitch(Exception):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "def plan_adoption(seals):\n"
+        "    if not seals:\n"
+        "        raise WireGlitch('no seals')\n"
+        "    return seals\n")}, rules=[R.ExceptionContractRule])
+    found = by_rule(res, "exception-contract")
+    assert len(found) == 1, [f.render() for f in res.findings]
+    assert "WireGlitch" in found[0].message
+    assert "SealChainError" in found[0].message  # the vocabulary
+
+
+def test_contract_documented_and_subclass_negative(tmp_path):
+    """Raising the promised type — or any subclass of it — is inside
+    the contract."""
+    res = lint(tmp_path, {"cometbft_tpu/sealsync/chain.py": (
+        "class SealChainError(Exception):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "class SealForged(SealChainError):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "def plan_adoption(seals):\n"
+        "    if not seals:\n"
+        "        raise SealForged('forged')\n"
+        "    return seals\n")}, rules=[R.ExceptionContractRule])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_contract_interprocedural_escape_and_mapping(tmp_path):
+    """The escape analysis is transitive: a helper module's raise
+    surfaces through the seam unless caught; catching and mapping to
+    the documented type closes it."""
+    wire = (
+        "class WireGlitch(Exception):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "def read_frame(buf):\n"
+        "    if not buf:\n"
+        "        raise WireGlitch('empty frame')\n"
+        "    return buf\n")
+    leaky_chain = (
+        "from .wire import read_frame\n"
+        "\n"
+        "\n"
+        "class SealChainError(Exception):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "def plan_adoption(seals):\n"
+        "    return [read_frame(s) for s in seals]\n")
+    res = lint(tmp_path, {
+        "cometbft_tpu/sealsync/wire.py": wire,
+        "cometbft_tpu/sealsync/chain.py": leaky_chain,
+    }, rules=[R.ExceptionContractRule])
+    found = by_rule(res, "exception-contract")
+    assert found and "WireGlitch" in found[0].message, \
+        [f.render() for f in res.findings]
+
+    mapped_chain = (
+        "from .wire import WireGlitch, read_frame\n"
+        "\n"
+        "\n"
+        "class SealChainError(Exception):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "def plan_adoption(seals):\n"
+        "    try:\n"
+        "        return [read_frame(s) for s in seals]\n"
+        "    except WireGlitch as e:\n"
+        "        raise SealChainError(str(e))\n")
+    write_tree(tmp_path / "m", {
+        "cometbft_tpu/sealsync/wire.py": wire,
+        "cometbft_tpu/sealsync/chain.py": mapped_chain,
+    })
+    res = run_checks(str(tmp_path / "m"), tree_rules=True,
+                     rules=[R.ExceptionContractRule])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+# --- acceptance goldens: the real tree ------------------------------------
+
+def test_real_tree_interval_proof_empty_baseline():
+    """THE acceptance golden: the interval interpreter proves the
+    int32 no-overflow contract over the real ops/ tree with an EMPTY
+    baseline — zero findings, zero holes — covering every jit/scan/
+    pallas entry (>= 9) and >= 124 reached functions."""
+    from tools.staticcheck.interval_rules import analyze_tree
+    analysis = analyze_tree(REPO)
+    assert not analysis.findings, analysis.findings
+    assert len(analysis.entries) >= 9, analysis.entries
+    assert len(analysis.covered) >= 124, \
+        f"coverage collapsed: {len(analysis.covered)} functions"
+
+
+def test_real_tree_lifecycle_and_contract_clean():
+    """The real tree satisfies both v3 rule families with only the
+    documented allow() seams (mempool lock()/unlock()) — and none of
+    those pragmas are stale."""
+    res = run_checks(REPO, rules=[R.ResourceLifecycleRule,
+                                  R.ExceptionContractRule])
+    assert res.findings == [], "\n".join(
+        f.render() for f in res.findings)
+    assert res.ok
+
+
+# --- runner satellites: SARIF + the fuzz harness --------------------------
+
+def test_sarif_output_shape():
+    """--format sarif emits parseable SARIF 2.1.0: driver metadata,
+    one reportingDescriptor per active rule, and invocation
+    properties carrying the per-rule timings."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.staticcheck",
+         "--rule", "wallclock", "--rule", "raw-env",
+         "--format", "sarif"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == ["wallclock", "raw-env"]
+    inv = run["invocations"][0]
+    assert inv["executionSuccessful"] is True
+    assert set(inv["properties"]["ruleSeconds"]) == {"wallclock",
+                                                     "raw-env"}
+    assert run["results"] == []  # the tree is clean under these rules
+
+
+def test_interval_fuzz_shadow_backend_detects_escapes():
+    """The differential harness's shadow arithmetic is not vacuous:
+    an int32 product past 2**31 raises Counterexample, uint32 wraps
+    (sha512's carry detection depends on it), and astype(int32)
+    asserts the value actually fits."""
+    import numpy as np
+
+    from tools.interval_fuzz import Counterexample, as_sa
+
+    x = as_sa(np.full((4,), 60000, dtype=np.int64), "int32")
+    try:
+        _ = x * x  # 3.6e9 > 2**31-1
+    except Counterexample:
+        pass
+    else:
+        raise AssertionError("int32 escape not detected")
+
+    u = as_sa(np.full((4,), (1 << 32) - 1, dtype=np.uint64), "uint32")
+    wrapped = u + 1
+    assert int(wrapped.a[0]) == 0  # uint32 wraps, never raises
+
+    big = as_sa(np.full((2,), (1 << 31) + 5, dtype=np.uint64),
+                "uint32")
+    try:
+        big.astype("int32")
+    except Counterexample:
+        pass
+    else:
+        raise AssertionError("astype(int32) overflow not detected")

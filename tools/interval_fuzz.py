@@ -1,0 +1,923 @@
+"""Differential fuzzer for the kernel-interval no-overflow proof.
+
+The staticcheck `kernel-interval` rule (tools/staticcheck/
+interval_rules.py) proves, by interval abstract interpretation, that no
+int32 value inside an ops/ kernel ever leaves [-2**31, 2**31).  This
+harness attacks that proof from the concrete side: it executes the SAME
+kernel source under a shim `jax` whose arrays hold exact Python ints
+(numpy object arrays), samples every input uniformly inside the
+interval its `# staticcheck: assume(...)` pragma claims (with a bias
+toward the lo/hi endpoints, where overflows live), and asserts the
+int32 contract on EVERY intermediate operation:
+
+- int32 results must lie in [-2**31, 2**31) — an escape is a concrete
+  counterexample that disproves the analyzer's verdict and fails the
+  suite (exit 1, with the kernel, seed, and op location to replay);
+- uint32/uint8 results wrap (hardware semantics — sha512's carry
+  detection deliberately overflows uint32, that is not a finding);
+- `.astype(int32)` asserts the value already fits (the analyzer models
+  the conversion as exact, so a wrapping conversion would silently
+  invalidate every downstream bound).
+
+Because every element of every input is an independent draw from its
+claimed interval, one batched execution yields thousands of samples;
+the per-kernel sample counts reported (and enforced: >= --samples,
+default 1000) count those sampled scalars.
+
+Scope notes (kept honest in the report):
+- Mid-function assume() obligations are subsumed: the shadow checks
+  every op, not just the annotated sites.
+- The two bls12 chain entries close over fixed ~quadruple-length
+  static bit strings (HARD_BITS, the Fermat exponent); the shadow runs
+  the identical loop bodies over truncated static chains — the per-op
+  interval claims are chain-length-invariant (the analyzer itself
+  proves them as a loop fixpoint), but the full-length chains are only
+  executed on real jax (tests/test_aggsig).
+
+Usage:
+    python -m tools.interval_fuzz              # full: 3 seeds/kernel
+    python -m tools.interval_fuzz --quick      # 1 seed/kernel (CI)
+    python -m tools.interval_fuzz --kernel rlc_epilogue --seed 7
+    python -m tools.interval_fuzz --list
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+import time
+import traceback
+import types
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+I32_MIN, I32_MAX = -(1 << 31), (1 << 31) - 1
+_WRAP = {"uint32": (1 << 32) - 1, "uint8": 0xFF}
+
+
+class Counterexample(Exception):
+    """A concrete int32 escape — disproves the interval proof."""
+
+    def __init__(self, msg: str, where: str):
+        super().__init__(msg)
+        self.where = where
+
+
+def _blame() -> str:
+    """Innermost cometbft_tpu/ops frame of the current stack — the
+    kernel source line the overflowing op lives on."""
+    for fr in reversed(traceback.extract_stack()):
+        if f"cometbft_tpu{os.sep}ops" in fr.filename:
+            return f"{os.path.relpath(fr.filename, ROOT)}:{fr.lineno} " \
+                   f"({fr.name}) {fr.line}"
+    return "<outside ops/>"
+
+
+# --- shadow arrays ----------------------------------------------------------
+#
+# SA wraps a numpy object array of exact Python ints plus a dtype tag.
+# Arithmetic is exact; the tag decides what happens to the exact result:
+# int32 escapes raise, unsigned dtypes wrap, bool stays 0/1.
+
+def _rank(dt: str) -> int:
+    return {"bool": 0, "uint8": 1, "int32": 2, "uint32": 3}[dt]
+
+
+def _promote(a: str, b: str) -> str:
+    return a if _rank(a) >= _rank(b) else b
+
+
+class SA:
+    __slots__ = ("a", "dtype")
+
+    def __init__(self, a: np.ndarray, dtype: str):
+        self.a = a
+        self.dtype = dtype
+
+    # -- construction with the contract check --------------------------
+    @staticmethod
+    def make(a, dtype: str) -> "SA":
+        if not isinstance(a, np.ndarray):
+            # 0-d object arrays decay to python scalars under numpy ops
+            a = np.array(a, dtype=object)
+        if dtype == "int32" and a.size:
+            mn, mx = a.min(), a.max()
+            if mn < I32_MIN or mx > I32_MAX:
+                bad = mx if mx > I32_MAX else mn
+                raise Counterexample(
+                    f"int32 escape: value {bad} outside "
+                    f"[-2**31, 2**31) at {_blame()}", _blame())
+        elif dtype in _WRAP and a.size:
+            m = _WRAP[dtype]
+            if a.min() < 0 or a.max() > m:
+                a = a & m
+        elif dtype == "bool":
+            a = a != 0
+        return SA(a, dtype)
+
+    # -- numpy-ish surface ---------------------------------------------
+    @property
+    def shape(self):
+        return self.a.shape
+
+    @property
+    def ndim(self):
+        return self.a.ndim
+
+    def reshape(self, *s):
+        if len(s) == 1 and isinstance(s[0], (tuple, list)):
+            s = tuple(s[0])
+        return SA(self.a.reshape(s), self.dtype)
+
+    def astype(self, dt) -> "SA":
+        dt = _dt_name(dt)
+        if dt == self.dtype:
+            return self
+        if dt == "bool":
+            return SA(self.a != 0, "bool")
+        a = self.a
+        if self.dtype == "bool":
+            a = np.asarray(a.astype(object) * 1, dtype=object)
+        if dt == "int32" and a.size:
+            mn, mx = a.min(), a.max()
+            if mn < I32_MIN or mx > I32_MAX:
+                # the analyzer models astype(int32) as exact — a
+                # wrapping conversion invalidates every downstream bound
+                raise Counterexample(
+                    f"astype(int32) of out-of-range value "
+                    f"{mx if mx > I32_MAX else mn} at {_blame()}",
+                    _blame())
+        return SA.make(a, dt)
+
+    def item(self):
+        return self.a.item()
+
+    def __int__(self):
+        return int(self.a.item())
+
+    def __bool__(self):
+        if self.a.size != 1:
+            raise ValueError("truth value of non-scalar shadow array")
+        return bool(self.a.item())
+
+    def __index__(self):
+        return int(self.a.item())
+
+    def __len__(self):
+        return self.a.shape[0]
+
+    def __getitem__(self, idx):
+        idx = _coerce_index(idx)
+        r = self.a[idx]
+        if not isinstance(r, np.ndarray):
+            r = np.array(r, dtype=object)
+        return SA(r, self.dtype)
+
+    @property
+    def at(self):
+        return _At(self)
+
+    # -- arithmetic ----------------------------------------------------
+    def _bin(self, other, fn, out_dt: Optional[str] = None) -> "SA":
+        oa, odt = _operand(other, self.dtype)
+        dt = out_dt or _promote(self.dtype, odt)
+        if dt == "bool" and out_dt is None:
+            dt = "int32" if fn not in (_and, _or, _xor) else "bool"
+        return SA.make(fn(self.a, oa), dt)
+
+    def _rbin(self, other, fn, out_dt: Optional[str] = None) -> "SA":
+        oa, odt = _operand(other, self.dtype)
+        dt = out_dt or _promote(self.dtype, odt)
+        if dt == "bool" and out_dt is None:
+            dt = "int32" if fn not in (_and, _or, _xor) else "bool"
+        return SA.make(fn(oa, self.a), dt)
+
+    def __add__(self, o):
+        return self._bin(o, lambda a, b: a + b)
+
+    def __radd__(self, o):
+        return self._rbin(o, lambda a, b: a + b)
+
+    def __sub__(self, o):
+        return self._bin(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._rbin(o, lambda a, b: a - b)
+
+    def __mul__(self, o):
+        return self._bin(o, lambda a, b: a * b)
+
+    def __rmul__(self, o):
+        return self._rbin(o, lambda a, b: a * b)
+
+    def __floordiv__(self, o):
+        return self._bin(o, lambda a, b: a // b)
+
+    def __mod__(self, o):
+        return self._bin(o, lambda a, b: a % b)
+
+    def __rshift__(self, o):
+        return self._bin(o, lambda a, b: a >> b)
+
+    def __lshift__(self, o):
+        return self._bin(o, lambda a, b: a << b)
+
+    def __and__(self, o):
+        return self._bin(o, _and)
+
+    def __rand__(self, o):
+        return self._rbin(o, _and)
+
+    def __or__(self, o):
+        return self._bin(o, _or)
+
+    def __ror__(self, o):
+        return self._rbin(o, _or)
+
+    def __xor__(self, o):
+        return self._bin(o, _xor)
+
+    def __neg__(self):
+        return SA.make(-(self.a.astype(object) * 1
+                         if self.dtype == "bool" else self.a),
+                       "int32" if self.dtype == "bool" else self.dtype)
+
+    def __invert__(self):
+        if self.dtype == "bool":
+            return SA(~(self.a.astype(bool)), "bool")
+        return self._bin(-1, _xor)
+
+    def _cmp(self, o, fn) -> "SA":
+        oa, _ = _operand(o, self.dtype)
+        r = np.asarray(fn(self.a, oa))
+        return SA(r.astype(bool), "bool")
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._cmp(o, lambda a, b: a == b)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._cmp(o, lambda a, b: a != b)
+
+    def __lt__(self, o):
+        return self._cmp(o, lambda a, b: a < b)
+
+    def __le__(self, o):
+        return self._cmp(o, lambda a, b: a <= b)
+
+    def __gt__(self, o):
+        return self._cmp(o, lambda a, b: a > b)
+
+    def __ge__(self, o):
+        return self._cmp(o, lambda a, b: a >= b)
+
+    __hash__ = None  # type: ignore[assignment]
+
+    def __repr__(self):
+        return f"SA{self.shape}:{self.dtype}"
+
+
+def _and(a, b):
+    return a & b
+
+
+def _or(a, b):
+    return a | b
+
+
+def _xor(a, b):
+    return a ^ b
+
+
+class _At:
+    """`x.at[idx].set(v)` — functional update, copy-on-write."""
+
+    def __init__(self, sa: SA):
+        self.sa = sa
+
+    def __getitem__(self, idx):
+        sa = self.sa
+
+        class _Upd:
+            @staticmethod
+            def set(v):
+                a = sa.a.copy()
+                a[_coerce_index(idx)] = _operand(v, sa.dtype)[0]
+                return SA.make(a, sa.dtype)
+
+            @staticmethod
+            def add(v):
+                a = sa.a.copy()
+                ci = _coerce_index(idx)
+                a[ci] = a[ci] + _operand(v, sa.dtype)[0]
+                return SA.make(a, sa.dtype)
+
+        return _Upd
+
+
+def _coerce_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_coerce_index(i) for i in idx)
+    if isinstance(idx, SA):
+        return int(idx) if idx.a.ndim == 0 else idx.a.astype(
+            bool if idx.dtype == "bool" else int)
+    return idx
+
+
+def _dt_name(dt) -> str:
+    if isinstance(dt, str):
+        return dt
+    if dt is bool:
+        return "bool"
+    if dt is int:
+        return "int32"
+    name = getattr(dt, "__name__", None) or str(np.dtype(dt))
+    return {"bool_": "bool", "int64": "int32"}.get(name, name)
+
+
+def _operand(v, ctx_dt: str) -> Tuple[Any, str]:
+    """(object-array-or-scalar, dtype) view of any operand."""
+    if isinstance(v, SA):
+        a = v.a
+        if v.dtype == "bool":
+            return a.astype(object) * 1, "bool"
+        return a, v.dtype
+    if isinstance(v, np.ndarray):
+        return v.astype(object), _dt_name(v.dtype)
+    if isinstance(v, np.generic):
+        return int(v), _dt_name(v.dtype)
+    if isinstance(v, bool):
+        return int(v), "bool"
+    if isinstance(v, int):
+        return v, ctx_dt          # python scalar adopts context dtype
+    if isinstance(v, (list, tuple)):
+        return np.array(v, dtype=object), ctx_dt
+    raise TypeError(f"shadow op with {type(v).__name__}")
+
+
+def as_sa(v, dtype: Optional[str] = None) -> SA:
+    if isinstance(v, SA):
+        return v.astype(dtype) if dtype else v
+    if isinstance(v, np.ndarray):
+        dt = dtype or _dt_name(v.dtype)
+        return SA.make(v.astype(object), dt)
+    if isinstance(v, np.generic):
+        dt = dtype or _dt_name(v.dtype)
+        return SA.make(np.array(int(v), dtype=object), dt)
+    if isinstance(v, (bool, int)):
+        dt = dtype or ("bool" if isinstance(v, bool) else "int32")
+        return SA.make(np.array(int(v), dtype=object), dt)
+    if isinstance(v, (list, tuple)):
+        return SA.make(np.array(v, dtype=object), dtype or "int32")
+    raise TypeError(f"cannot shadow {type(v).__name__}")
+
+
+# --- pytree helpers (tuples/lists/dicts of SA) ------------------------------
+
+def _tree_map(fn, *trees):
+    t0 = trees[0]
+    if isinstance(t0, (tuple, list)):
+        return type(t0)(_tree_map(fn, *elems) for elems in zip(*trees))
+    if isinstance(t0, dict):
+        return {k: _tree_map(fn, *(t[k] for t in trees)) for k in t0}
+    return fn(*trees)
+
+
+def _tree_leaves(t, out):
+    if isinstance(t, (tuple, list)):
+        for e in t:
+            _tree_leaves(e, out)
+    elif isinstance(t, dict):
+        for k in sorted(t):
+            _tree_leaves(t[k], out)
+    elif t is not None:
+        out.append(t)
+    return out
+
+
+# --- the jax shim -----------------------------------------------------------
+
+def _np_of(v):
+    return v.a if isinstance(v, SA) else (
+        v.astype(object) if isinstance(v, np.ndarray) else v)
+
+
+def _dt_of(v, default="int32"):
+    if isinstance(v, SA):
+        return v.dtype
+    if isinstance(v, np.ndarray):
+        return _dt_name(v.dtype)
+    return default
+
+
+def _uniform_dt(xs):
+    dt = "bool"
+    for x in xs:
+        dt = _promote(dt, _dt_of(x))
+    return dt
+
+
+def _mk_jnp() -> types.ModuleType:
+    jnp = types.ModuleType("jax.numpy")
+    jnp.ndarray = SA
+    jnp.int32 = "int32"
+    jnp.uint32 = "uint32"
+    jnp.uint8 = "uint8"
+    jnp.bool_ = "bool"
+
+    def asarray(x, dtype=None):
+        return as_sa(x, _dt_name(dtype) if dtype is not None else None)
+
+    def zeros(shape, dtype="int32"):
+        return SA(np.zeros(shape, dtype=object), _dt_name(dtype))
+
+    def ones(shape, dtype="int32"):
+        return SA(np.ones(shape, dtype=object) * 1, _dt_name(dtype))
+
+    def zeros_like(x):
+        x = as_sa(x)
+        return SA(np.zeros(x.shape, dtype=object), x.dtype)
+
+    def arange(n, dtype="int32"):
+        return SA(np.arange(int(n)).astype(object), _dt_name(dtype))
+
+    def stack(xs, axis=0):
+        xs = list(xs)
+        dt = _uniform_dt(xs)
+        return SA.make(np.stack([_np_of(as_sa(x)) for x in xs],
+                                axis=axis), dt)
+
+    def concatenate(xs, axis=0):
+        xs = list(xs)
+        dt = _uniform_dt(xs)
+        return SA.make(np.concatenate([_np_of(as_sa(x)) for x in xs],
+                                      axis=axis), dt)
+
+    def where(cond, a, b):
+        c = as_sa(cond).a
+        sa, sb = as_sa(a), as_sa(b)
+        return SA.make(np.where(c.astype(bool), _np_of(sa), _np_of(sb)),
+                       _promote(sa.dtype, sb.dtype))
+
+    def moveaxis(x, src, dst):
+        x = as_sa(x)
+        return SA(np.moveaxis(x.a, src, dst), x.dtype)
+
+    def transpose(x, axes=None):
+        x = as_sa(x)
+        return SA(np.transpose(x.a, axes), x.dtype)
+
+    def broadcast_to(x, shape):
+        x = as_sa(x)
+        return SA(np.broadcast_to(x.a, shape), x.dtype)
+
+    def broadcast_arrays(*xs):
+        sas = [as_sa(x) for x in xs]
+        bs = np.broadcast_arrays(*[s.a for s in sas])
+        return [SA(b, s.dtype) for b, s in zip(bs, sas)]
+
+    def all_(x, axis=None):
+        x = as_sa(x)
+        r = np.all(x.a.astype(bool), axis=axis)
+        if not isinstance(r, np.ndarray):
+            r = np.array(bool(r), dtype=object)
+        return SA(r, "bool")
+
+    def sum_(x, axis=None, dtype=None):
+        x = as_sa(x)
+        r = np.sum(x.a if x.dtype != "bool" else x.a.astype(object) * 1,
+                   axis=axis)
+        if not isinstance(r, np.ndarray):
+            r = np.array(r, dtype=object)
+        dt = _dt_name(dtype) if dtype else (
+            "int32" if x.dtype == "bool" else x.dtype)
+        return SA.make(r, dt)
+
+    def take(x, idx, axis=None):
+        x = as_sa(x)
+        if isinstance(idx, SA):
+            idx = (int(idx) if idx.a.ndim == 0
+                   else idx.a.astype(int))
+        return SA(np.take(x.a, idx, axis=axis), x.dtype)
+
+    jnp.asarray = asarray
+    jnp.array = asarray
+    jnp.zeros = zeros
+    jnp.ones = ones
+    jnp.zeros_like = zeros_like
+    jnp.ones_like = lambda x: ones(as_sa(x).shape, as_sa(x).dtype)
+    jnp.arange = arange
+    jnp.stack = stack
+    jnp.concatenate = concatenate
+    jnp.where = where
+    jnp.moveaxis = moveaxis
+    jnp.transpose = transpose
+    jnp.broadcast_to = broadcast_to
+    jnp.broadcast_arrays = broadcast_arrays
+    jnp.broadcast_shapes = np.broadcast_shapes
+    jnp.all = all_
+    jnp.sum = sum_
+    jnp.take = take
+    return jnp
+
+
+def _mk_lax() -> types.ModuleType:
+    lax = types.ModuleType("jax.lax")
+
+    def scan(f, init, xs, length=None):
+        if xs is None:
+            n = int(length)
+            steps = [None] * n
+        else:
+            leaves = _tree_leaves(xs, [])
+            n = leaves[0].shape[0]
+            steps = [_tree_map(lambda l: l[i], xs) for i in range(n)]
+        carry, ys = init, []
+        for st in steps:
+            carry, y = f(carry, st)
+            ys.append(y)
+        if not ys or all(y is None for y in ys):
+            return carry, None
+        stacked = _tree_map(
+            lambda *row: SA.make(
+                np.stack([_np_of(r) for r in row], axis=0),
+                _uniform_dt(row)), *ys)
+        return carry, stacked
+
+    def fori_loop(lo, hi, body, init):
+        v = init
+        for i in range(int(lo), int(hi)):
+            v = body(i, v)
+        return v
+
+    def dynamic_slice(x, starts, sizes):
+        x = as_sa(x)
+        idx = tuple(slice(int(s), int(s) + int(z))
+                    for s, z in zip(starts, sizes))
+        return SA(x.a[idx], x.dtype)
+
+    def dynamic_index_in_dim(x, i, axis=0, keepdims=True):
+        x = as_sa(x)
+        i = int(i)
+        idx = [slice(None)] * x.ndim
+        idx[axis] = slice(i, i + 1) if keepdims else i
+        r = x.a[tuple(idx)]
+        if not isinstance(r, np.ndarray):
+            r = np.array(r, dtype=object)
+        return SA(r, x.dtype)
+
+    lax.scan = scan
+    lax.fori_loop = fori_loop
+    lax.dynamic_slice = dynamic_slice
+    lax.dynamic_index_in_dim = dynamic_index_in_dim
+    return lax
+
+
+# --- pallas shim ------------------------------------------------------------
+
+class ShapeDtypeStruct:
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = _dt_name(dtype)
+
+
+class BlockSpec:
+    def __init__(self, block_shape=None, index_map=None,
+                 memory_space=None):
+        self.block_shape = (tuple(block_shape)
+                            if block_shape is not None else None)
+        self.index_map = index_map
+
+
+class VMEM:
+    """Doubles as the memory_space token (the class object) and the
+    scratch-shape spec (instances)."""
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = _dt_name(dtype)
+
+
+class Ref:
+    """A mutable block view: reads return SA, writes land in the
+    (view of the) underlying object array."""
+
+    def __init__(self, a: np.ndarray, dtype: str):
+        self.a = a
+        self.dtype = dtype
+
+    @property
+    def shape(self):
+        return self.a.shape
+
+    def __getitem__(self, idx):
+        r = self.a[_coerce_index(idx)]
+        if not isinstance(r, np.ndarray):
+            r = np.array(r, dtype=object)
+        return SA(r, self.dtype)
+
+    def __setitem__(self, idx, val):
+        sa = as_sa(val, self.dtype)   # astype runs the contract check
+        self.a[_coerce_index(idx)] = sa.a
+
+
+def _block_view(arr: np.ndarray, spec: Optional[BlockSpec],
+                gidx: Tuple[int, ...]) -> np.ndarray:
+    if spec is None or spec.block_shape is None:
+        return arr
+    bs = spec.block_shape
+    if spec.index_map is None:
+        off = (0,) * len(bs)
+    else:
+        off = tuple(int(i) for i in spec.index_map(*gidx))
+    sl = tuple(slice(o * b, o * b + b) for o, b in zip(off, bs))
+    return arr[sl]
+
+
+def _pallas_call(kernel, out_shape, grid=None, in_specs=None,
+                 out_specs=None, scratch_shapes=(), interpret=False,
+                 **_kw):
+    multi = isinstance(out_shape, (tuple, list))
+    outs = list(out_shape) if multi else [out_shape]
+    out_sp = (list(out_specs) if isinstance(out_specs, (tuple, list))
+              else [out_specs])
+
+    def call(*inputs):
+        sas = [as_sa(x) for x in inputs]
+        bufs = [np.zeros(o.shape, dtype=object) for o in outs]
+        steps = ([()] if not grid else
+                 [(i,) for i in range(int(grid[0]))] if len(grid) == 1
+                 else list(np.ndindex(*[int(g) for g in grid])))
+        specs = list(in_specs) if in_specs else [None] * len(sas)
+        for gidx in steps:
+            refs = [Ref(_block_view(s.a, sp, gidx), s.dtype)
+                    for s, sp in zip(sas, specs)]
+            orefs = [Ref(_block_view(b, sp, gidx), o.dtype)
+                     for b, sp, o in zip(bufs, out_sp, outs)]
+            scratch = [Ref(np.zeros(sc.shape, dtype=object), sc.dtype)
+                       for sc in scratch_shapes]
+            kernel(*refs, *orefs, *scratch)
+        res = [SA.make(b, o.dtype) for b, o in zip(bufs, outs)]
+        return tuple(res) if multi else res[0]
+
+    return call
+
+
+def _install_shim() -> None:
+    if "jax" in sys.modules:
+        raise SystemExit(
+            "interval_fuzz must own the `jax` module: run it in a "
+            "fresh interpreter (python -m tools.interval_fuzz), not "
+            "inside a process that already imported jax")
+    jax = types.ModuleType("jax")
+    jnp = _mk_jnp()
+    lax = _mk_lax()
+    tree_util = types.ModuleType("jax.tree_util")
+    tree_util.tree_map = _tree_map
+
+    def jit(fn=None, **_kw):
+        if fn is None:
+            return lambda f: f
+        return fn
+
+    jax.jit = jit
+    jax.numpy = jnp
+    jax.lax = lax
+    jax.tree_util = tree_util
+    jax.ShapeDtypeStruct = ShapeDtypeStruct
+
+    pallas = types.ModuleType("jax.experimental.pallas")
+    pallas.BlockSpec = BlockSpec
+    pallas.pallas_call = _pallas_call
+    pltpu = types.ModuleType("jax.experimental.pallas.tpu")
+    pltpu.VMEM = VMEM
+    pallas.tpu = pltpu
+    experimental = types.ModuleType("jax.experimental")
+    experimental.pallas = pallas
+    jax.experimental = experimental
+
+    sys.modules["jax"] = jax
+    sys.modules["jax.numpy"] = jnp
+    sys.modules["jax.lax"] = lax
+    sys.modules["jax.tree_util"] = tree_util
+    sys.modules["jax.experimental"] = experimental
+    sys.modules["jax.experimental.pallas"] = pallas
+    sys.modules["jax.experimental.pallas.tpu"] = pltpu
+
+
+# --- assume() spec extraction (same pragmas the analyzer seeds from) --------
+
+def _fn_specs(relpath: str, qual: str) -> Dict[str, Any]:
+    """assume() pragmas of the (possibly nested) function `qual` in
+    `relpath`: pragma lines sit between the `def` line and the first
+    body statement."""
+    from tools.staticcheck import parse_assume
+    path = os.path.join(ROOT, relpath)
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    lines = src.splitlines()
+    tree = ast.parse(src)
+    node: Any = tree
+    for part in qual.split("."):
+        node = next(n for n in ast.walk(node)
+                    if isinstance(n, ast.FunctionDef) and n.name == part)
+    specs: Dict[str, Any] = {}
+    for ln in range(node.lineno, node.body[0].lineno - 1):
+        sp = parse_assume(lines[ln], ln + 1)
+        if sp is not None:
+            specs[sp.var] = sp
+    if not specs:
+        raise SystemExit(f"{relpath}::{qual}: no assume() pragmas — "
+                         f"the fuzzer has nothing to sample inside")
+    return specs
+
+
+def _sample(spec, dims: Dict[str, int], rng: np.random.Generator
+            ) -> Tuple[SA, int]:
+    """One input drawn inside the claimed interval: uniform, with 1/8
+    of the elements pinned to the lo/hi endpoints."""
+    shape = tuple(dims[d] if isinstance(d, str) else d
+                  for d in (spec.shape or ()))
+    vals = rng.integers(spec.lo, spec.hi + 1, size=shape or (),
+                        dtype=np.int64)
+    edge = rng.random(size=shape or ()) < 0.125
+    ends = np.where(rng.random(size=shape or ()) < 0.5,
+                    spec.lo, spec.hi)
+    vals = np.where(edge, ends, vals)
+    arr = np.asarray(vals).astype(object)
+    if not shape:
+        return SA(np.array(int(arr), dtype=object), spec.dtype), 1
+    return SA(arr, spec.dtype), int(np.asarray(vals).size)
+
+
+# --- fuzz targets -----------------------------------------------------------
+#
+# Each target names the ops function whose assume() pragmas define the
+# input intervals, the dims to instantiate the symbolic axes with, and
+# how to call it. TILE is pinned to 8 (env override below) so pallas
+# grids stay small; TAIL=8 forces TILE >= 8.
+
+def _t_pallas(fn_name):
+    def run(specs, dims, rng, count):
+        import cometbft_tpu.ops.pallas_verify as pv
+        fn = getattr(pv, fn_name)
+        params = [p for p in specs
+                  if specs[p].shape is not None or p in ("bucket",)]
+        args = []
+        for p in params:
+            sa, n = _sample(specs[p], dims, rng)
+            args.append(sa)
+            count[0] += n
+        fn(*args)
+    return run
+
+
+def _t_ed25519(fn_name, with_z):
+    def run(specs, dims, rng, count):
+        import cometbft_tpu.ops.ed25519 as e
+        fn = getattr(e, fn_name)
+        order = ["pub", "sig", "hblocks", "hnblocks"] + (
+            ["z"] if with_z else [])
+        args = []
+        for p in order:
+            sp = specs[p]
+            if p == "hnblocks":
+                # live block count can't exceed the padded B axis —
+                # sample the [1, B] sub-interval of the claim
+                vals = rng.integers(1, dims["B"] + 1,
+                                    size=(dims["N"],)).astype(object)
+                args.append(SA(vals, "int32"))
+                count[0] += dims["N"]
+                continue
+            sa, n = _sample(sp, dims, rng)
+            args.append(sa)
+            count[0] += n
+        fn(*args)
+    return run
+
+
+def _t_bls_pow(specs, dims, rng, count):
+    import cometbft_tpu.ops.bls12 as b
+    arr, n = _sample(specs["arr"], dims, rng)
+    count[0] += n
+    # short static chain: same loop body as HARD_BITS, truncated
+    b._compiled(dims["B"], (1, 0, 1, 1, 0, 1))(arr)
+
+
+def _t_bls_miller(specs, dims, rng, count):
+    import cometbft_tpu.ops.bls12 as b
+    lines, n = _sample(specs["lines"], dims, rng)
+    count[0] += n
+    m = b._unpack_tree(b.miller_scan(lines))
+    b.final_exp_easy_j(m)   # incl. the Fermat-inversion scan
+
+
+TARGETS: List[Tuple[str, str, str, Dict[str, int], Dict[str, int],
+                    Any]] = [
+    # (name, relpath, qualname-with-the-pragmas, dims,
+    #  quick-mode dim overrides, runner)
+    ("pt_add_tiled", "cometbft_tpu/ops/pallas_verify.py",
+     "pt_add_tiled", {"N": 16}, {}, _t_pallas("pt_add_tiled")),
+    ("rlc_window_sums", "cometbft_tpu/ops/pallas_verify.py",
+     "rlc_window_sums_impl", {"N": 8}, {},
+     _t_pallas("rlc_window_sums_impl")),
+    ("pt_decompress_tiled", "cometbft_tpu/ops/pallas_verify.py",
+     "pt_decompress_tiled_impl", {"N": 16}, {},
+     _t_pallas("pt_decompress_tiled_impl")),
+    ("rlc_epilogue", "cometbft_tpu/ops/pallas_verify.py",
+     "rlc_epilogue_impl", {"M": 2}, {}, _t_pallas("rlc_epilogue_impl")),
+    ("verify_core", "cometbft_tpu/ops/ed25519.py",
+     "verify_core", {"N": 8, "B": 2}, {"B": 1},
+     _t_ed25519("verify_core", False)),
+    ("verify_rlc_core", "cometbft_tpu/ops/ed25519.py",
+     "verify_rlc_core", {"N": 8, "B": 2}, {"B": 1},
+     _t_ed25519("verify_rlc_core", True)),
+    ("verify_rlc_core_pallas", "cometbft_tpu/ops/ed25519.py",
+     "verify_rlc_core_pallas", {"N": 8, "B": 2}, {"B": 1},
+     _t_ed25519("verify_rlc_core_pallas", True)),
+    ("bls12_pow_is_one", "cometbft_tpu/ops/bls12.py",
+     "_compiled.run", {"B": 4}, {}, _t_bls_pow),
+    ("bls12_miller_finalexp", "cometbft_tpu/ops/bls12.py",
+     "_compiled_miller.run", {"S": 2, "B": 4}, {}, _t_bls_miller),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.interval_fuzz",
+        description="concrete-execution differential check of the "
+                    "kernel-interval no-overflow proof")
+    ap.add_argument("--quick", action="store_true",
+                    help="one seed per kernel (CI smoke; full mode "
+                         "runs 3)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed (per-kernel seeds derive from it)")
+    ap.add_argument("--samples", type=int, default=1000,
+                    help="minimum sampled scalars per kernel "
+                         "(reruns with fresh seeds until reached)")
+    ap.add_argument("--kernel", action="append", default=None,
+                    help="run only this target (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list fuzz targets")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, rel, qual, dims, _qdims, _run in TARGETS:
+            print(f"{name:24s} {rel}::{qual}  dims={dims}")
+        return 0
+
+    targets = TARGETS
+    if args.kernel:
+        by = {t[0]: t for t in TARGETS}
+        unknown = [k for k in args.kernel if k not in by]
+        if unknown:
+            print(f"unknown kernel(s): {', '.join(unknown)} "
+                  f"(see --list)", file=sys.stderr)
+            return 2
+        targets = [by[k] for k in args.kernel]
+
+    # TILE=8 keeps pallas grids/trees tiny (TAIL=8 is the floor);
+    # must be set before cometbft_tpu.ops.pallas_verify is imported
+    os.environ["COMETBFT_TPU_PALLAS_TILE"] = "8"
+    _install_shim()
+    sys.path.insert(0, ROOT)
+
+    rounds = 1 if args.quick else 3
+    failed = False
+    for name, rel, qual, dims, qdims, run in targets:
+        if args.quick:
+            dims = {**dims, **qdims}
+        specs = _fn_specs(rel, qual)
+        t0 = time.monotonic()
+        count = [0]
+        seed_used = None
+        try:
+            r = 0
+            while r < rounds or count[0] < args.samples:
+                seed_used = (args.seed * 10007
+                             + zlib.crc32(name.encode()) % 65536 + r)
+                rng = np.random.default_rng(seed_used)
+                run(specs, dims, rng, count)
+                r += 1
+        except Counterexample as e:
+            failed = True
+            print(f"FAIL {name}: {e}  [seed {seed_used}] — the "
+                  f"kernel-interval proof is unsound here; replay: "
+                  f"python -m tools.interval_fuzz --kernel {name} "
+                  f"--seed {args.seed}", file=sys.stderr)
+            continue
+        dt = time.monotonic() - t0
+        print(f"ok {name}: {count[0]} samples, {r} run(s), {dt:.1f}s")
+    if failed:
+        return 1
+    print("interval_fuzz: all kernels clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,116 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 _PRAGMA_RE = re.compile(r"#\s*staticcheck:\s*allow\(([\w\-, ]+)\)")
+_ASSUME_RE = re.compile(r"#\s*staticcheck:\s*assume\(")
+
+
+@dataclass(frozen=True)
+class Assume:
+    """One `# staticcheck: assume(var, lo, hi[, shape=...][, dtype=...])`
+    pragma. Unlike allow(), an assume is CHECKED, not trusted: the
+    interval rule re-verifies the claimed range at the assumption site
+    (computed ⊆ assumed → proven; disjoint → contradiction finding;
+    overlap → refined + registered as a runtime obligation that
+    tools/interval_fuzz.py re-checks on concrete executions). On an
+    entry parameter (pragma lines between `def` and the first body
+    statement) it is the entry precondition the fuzzer samples inside.
+
+    lo/hi accept pure arithmetic literals (`2**16 - 1`). shape= is a
+    tuple of int literals and/or bare symbol names; the same symbol
+    used across one def's assume block names the same dimension.
+    dtype= is one of int32/uint32/uint8/bool (default int32)."""
+    var: str
+    lo: int
+    hi: int
+    shape: Optional[Tuple[object, ...]]   # ints and/or str dim symbols
+    dtype: str
+    line: int
+
+
+def _const_int(node: ast.AST) -> int:
+    """Evaluate a pure arithmetic literal (no names, no calls)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd, ast.Invert)):
+        v = _const_int(node.operand)
+        return -v if isinstance(node.op, ast.USub) else (
+            ~v if isinstance(node.op, ast.Invert) else v)
+    if isinstance(node, ast.BinOp):
+        ops = {ast.Add: lambda a, b: a + b, ast.Sub: lambda a, b: a - b,
+               ast.Mult: lambda a, b: a * b, ast.Pow: lambda a, b: a ** b,
+               ast.LShift: lambda a, b: a << b,
+               ast.RShift: lambda a, b: a >> b,
+               ast.FloorDiv: lambda a, b: a // b,
+               ast.Mod: lambda a, b: a % b,
+               ast.BitOr: lambda a, b: a | b,
+               ast.BitAnd: lambda a, b: a & b,
+               ast.BitXor: lambda a, b: a ^ b}
+        fn = ops.get(type(node.op))
+        if fn is not None:
+            return fn(_const_int(node.left), _const_int(node.right))
+    raise ValueError(f"not a pure int literal: {ast.dump(node)}")
+
+
+_ASSUME_DTYPES = {"int32", "uint32", "uint8", "int8", "bool"}
+
+
+def parse_assume(text: str, line: int) -> Optional[Assume]:
+    """Parse one source line's assume() pragma; raises ValueError on a
+    malformed one (flagged by the stale-pragma audit — a half-written
+    assume must not silently vanish). Returns None when no pragma."""
+    m = _ASSUME_RE.search(text)
+    if not m:
+        return None
+    # balanced-paren scan: shape=(...) nests inside the pragma parens
+    depth, i = 1, m.end()
+    while i < len(text) and depth:
+        depth += {"(": 1, ")": -1}.get(text[i], 0)
+        i += 1
+    if depth:
+        raise ValueError("unbalanced parens in assume()")
+    argsrc = text[m.end():i - 1]
+    try:
+        call = ast.parse(f"_({argsrc})", mode="eval").body
+    except SyntaxError as e:
+        raise ValueError(f"unparseable assume args: {e}")
+    if not isinstance(call, ast.Call) or len(call.args) != 3:
+        raise ValueError("assume() wants (var, lo, hi[, shape=][, dtype=])")
+    var_node = call.args[0]
+    if not isinstance(var_node, ast.Name):
+        raise ValueError("assume() first arg must be a bare name")
+    lo, hi = _const_int(call.args[1]), _const_int(call.args[2])
+    if lo > hi:
+        raise ValueError(f"assume() empty range [{lo}, {hi}]")
+    shape: Optional[Tuple[object, ...]] = None
+    dtype = "int32"
+    for kw in call.keywords:
+        if kw.arg == "shape":
+            if not isinstance(kw.value, (ast.Tuple, ast.List)):
+                raise ValueError("shape= must be a tuple")
+            dims: List[object] = []
+            for el in kw.value.elts:
+                if isinstance(el, ast.Name):
+                    dims.append(el.id)
+                else:
+                    d = _const_int(el)
+                    if d < 1:
+                        raise ValueError(f"shape dim {d} < 1")
+                    dims.append(d)
+            shape = tuple(dims)
+        elif kw.arg == "dtype":
+            name = (kw.value.id if isinstance(kw.value, ast.Name)
+                    else kw.value.value
+                    if isinstance(kw.value, ast.Constant) else None)
+            if name not in _ASSUME_DTYPES:
+                raise ValueError(f"dtype= must be one of "
+                                 f"{sorted(_ASSUME_DTYPES)}")
+            dtype = name
+        else:
+            raise ValueError(f"unknown assume() keyword {kw.arg!r}")
+    return Assume(var=var_node.id, lo=lo, hi=hi, shape=shape,
+                  dtype=dtype, line=line)
 
 
 @dataclass(frozen=True)
@@ -92,6 +202,13 @@ class FileCtx:
         # its rule for the next statement too.
         self.pragmas: Dict[int, Set[str]] = {}
         self.comment_pragmas: Dict[int, Set[str]] = {}
+        # assume() pragmas: line -> parsed spec; comment-only assume
+        # lines cover code below them (same stacking rule as allow(),
+        # except a RUN of comment-only assume lines covers the next
+        # code line — entry preconditions are one pragma per param).
+        self.assumes: Dict[int, Assume] = {}
+        self.comment_assume_lines: Set[int] = set()
+        self.assume_errors: List[Tuple[int, str]] = []
         for i, text in enumerate(self.lines, start=1):
             m = _PRAGMA_RE.search(text)
             if m:
@@ -100,6 +217,15 @@ class FileCtx:
                 self.pragmas[i] = rules
                 if text.lstrip().startswith("#"):
                     self.comment_pragmas[i] = rules
+            try:
+                spec = parse_assume(text, i)
+            except ValueError as e:
+                self.assume_errors.append((i, str(e)))
+                continue
+            if spec is not None:
+                self.assumes[i] = spec
+                if text.lstrip().startswith("#"):
+                    self.comment_assume_lines.add(i)
 
     def line_text(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -142,6 +268,27 @@ class FileCtx:
         allowed = self.comment_pragmas.get(line - 1)
         return bool(allowed and rule in allowed)
 
+    def assumes_at(self, line: int) -> List[Assume]:
+        """assume() pragmas covering the statement at `line`: one on
+        the line itself, plus any contiguous run of comment-only
+        assume lines directly above it."""
+        out: List[Assume] = []
+        if line in self.assumes and line not in self.comment_assume_lines:
+            out.append(self.assumes[line])
+        j = line - 1
+        while j in self.comment_assume_lines:
+            out.append(self.assumes[j])
+            j -= 1
+        out.reverse()
+        return out
+
+    def assumes_between(self, lo: int, hi: int) -> List[Assume]:
+        """assume() pragmas on lines in [lo, hi] — the entry-
+        precondition form (pragma lines between `def` and the first
+        body statement)."""
+        return [self.assumes[i] for i in sorted(self.assumes)
+                if lo <= i <= hi]
+
 
 @dataclass
 class Result:
@@ -156,6 +303,9 @@ class Result:
     rule_seconds: Dict[str, float] = field(default_factory=dict)
     # (path, line, rule) inventory of every allow() pragma seen
     pragma_inventory: List[Tuple[str, int, str]] = field(
+        default_factory=list)
+    # (path, line, var) inventory of every assume() pragma seen
+    assume_inventory: List[Tuple[str, int, str]] = field(
         default_factory=list)
 
     @property
@@ -172,6 +322,7 @@ class Result:
             "baselined": len(self.baselined),
             "rule_seconds": {k: round(v, 4)
                              for k, v in sorted(self.rule_seconds.items())},
+            "assume_pragmas": len(self.assume_inventory),
         }
 
 
@@ -351,8 +502,36 @@ def run_checks(root: str,
     known = {cls.name for cls in rules_mod.ALL_RULES}
     known.add(STALE_PRAGMA_RULE)
     active_by_name = {r.name: r for r in active}
+    # assume() pragmas are audited by the rule that consumes them (the
+    # interval rule sets audits_assumes and records every applied
+    # pragma in used_assumes) — an assume the analyzer never reached
+    # is dead weight exactly like a dead allow().
+    assume_rule = next((r for r in active
+                        if getattr(r, "audits_assumes", False)), None)
+    assume_used: Set[Tuple[str, int]] = set(
+        getattr(assume_rule, "used_assumes", ()) or ())
     for path in sorted(ctxs):
         ctx = ctxs[path]
+        for line, err in ctx.assume_errors:
+            deferred.append((Finding(
+                STALE_PRAGMA_RULE, path, line,
+                f"malformed assume() pragma ({err}) — a half-written "
+                f"assume is silently inert", ctx.line_text(line)), ctx))
+        for line in sorted(ctx.assumes):
+            spec = ctx.assumes[line]
+            result.assume_inventory.append((path, line, spec.var))
+            if assume_rule is None or not assume_rule.applies_to(path):
+                continue  # not audited this run
+            if getattr(assume_rule, "needs_project", False) \
+                    and project is None:
+                continue  # the consuming rule didn't really run
+            if (path, line) not in assume_used:
+                deferred.append((Finding(
+                    STALE_PRAGMA_RULE, path, line,
+                    f"stale assume({spec.var}, ...): the interval "
+                    f"analyzer never reached this pragma — delete it "
+                    f"(an unchecked assume is an unreviewed trust "
+                    f"grant)", ctx.line_text(line)), ctx))
         for line in sorted(ctx.pragmas):
             for rule_name in sorted(ctx.pragmas[line]):
                 result.pragma_inventory.append((path, line, rule_name))

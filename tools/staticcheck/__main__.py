@@ -4,6 +4,7 @@
     python -m tools.staticcheck --list-rules
     python -m tools.staticcheck --list-pragmas # allow() inventory
     python -m tools.staticcheck --format json  # machine-readable + timings
+    python -m tools.staticcheck --format sarif # SARIF 2.1.0 (code scanning)
     python -m tools.staticcheck --rule lock-order --rule guarded-by
     python -m tools.staticcheck --fix-baseline # rewrite baseline to now
     python -m tools.staticcheck cometbft_tpu/p2p/switch.py  # subset
@@ -47,9 +48,11 @@ def main(argv=None) -> int:
                     help="run only this rule (repeatable) — bisect a "
                          "slow or regressing rule; baseline entries "
                          "for other rules are ignored, not stale")
-    ap.add_argument("--format", choices=("text", "json"), default="text",
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
                     help="json: findings + per-rule wall-time for "
-                         "run_suite/CI attribution")
+                         "run_suite/CI attribution; sarif: SARIF "
+                         "2.1.0 for code-scanning upload")
     args = ap.parse_args(argv)
 
     root = os.path.abspath(args.root) if args.root else os.path.dirname(
@@ -107,16 +110,21 @@ def main(argv=None) -> int:
                 if fp.split("|", 1)[0] in active]
 
     if args.list_pragmas:
-        for path, line, rule_name in res.pragma_inventory:
-            src = ""
+        def _src(path: str, line: int) -> str:
             try:
                 with open(os.path.join(root, path),
                           encoding="utf-8") as fh:
-                    src = fh.read().splitlines()[line - 1].strip()
+                    return fh.read().splitlines()[line - 1].strip()
             except (OSError, IndexError):
-                pass
-            print(f"{path}:{line}: allow({rule_name}) | {src}")
-        print(f"{len(res.pragma_inventory)} pragma(s)")
+                return ""
+        for path, line, rule_name in res.pragma_inventory:
+            print(f"{path}:{line}: allow({rule_name}) | "
+                  f"{_src(path, line)}")
+        for path, line, var in res.assume_inventory:
+            print(f"{path}:{line}: assume({var}, ...) | "
+                  f"{_src(path, line)}")
+        print(f"{len(res.pragma_inventory)} pragma(s), "
+              f"{len(res.assume_inventory)} assume(s)")
         return 0
 
     if args.fix_baseline:
@@ -134,6 +142,10 @@ def main(argv=None) -> int:
 
     if args.format == "json":
         print(json.dumps(res.to_json(), indent=1))
+        return 0 if res.ok else 1
+
+    if args.format == "sarif":
+        print(json.dumps(_to_sarif(res, rules or ALL_RULES), indent=1))
         return 0 if res.ok else 1
 
     for f in res.findings:
@@ -156,6 +168,57 @@ def main(argv=None) -> int:
           f"{len(res.stale_baseline)} stale baseline entr(y/ies) — "
           f"see docs/STATICCHECK.md", file=sys.stderr)
     return 1
+
+
+def _to_sarif(res, rule_classes) -> dict:
+    """SARIF 2.1.0 document: one run, one driver, the active rules as
+    reportingDescriptors, each finding a `result` with a stable
+    partialFingerprint (the baseline fingerprint, so code-scanning
+    dedup agrees with the baseline's identity notion)."""
+    rules_meta = [{
+        "id": cls.name,
+        "shortDescription": {"text": cls.doc},
+        "helpUri": "docs/STATICCHECK.md",
+    } for cls in rule_classes]
+    results = []
+    for f in res.findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "partialFingerprints": {
+                "staticcheck/v1": f.fingerprint(),
+            },
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "staticcheck",
+                "informationUri": "docs/STATICCHECK.md",
+                "rules": rules_meta,
+            }},
+            "results": results,
+            "invocations": [{
+                "executionSuccessful": res.ok,
+                "properties": {
+                    "ruleSeconds": {k: round(v, 4) for k, v in
+                                    sorted(res.rule_seconds.items())},
+                    "suppressed": res.suppressed,
+                    "baselined": len(res.baselined),
+                },
+            }],
+        }],
+    }
 
 
 if __name__ == "__main__":

@@ -1,0 +1,341 @@
+"""exception-contract — the typed-error vocabularies the docs promise
+are what actually escapes the public seams.
+
+The docs commit each subsystem seam to a small closed error
+vocabulary: a caller of `plan_adoption` handles `SealChainError` and
+nothing else; an RPC route maps EVERY typed error to an `RPCError`
+with a -320xx code before it crosses the wire (docs/RPC_PARITY.md,
+docs/MESH.md, docs/STORAGE.md, docs/SEALSYNC.md, docs/INGEST.md).
+A new typed error that silently starts escaping one of those seams is
+an API break no test catches until a peer sees a 500 instead of a
+-32005.
+
+Model (interprocedural, over the shared Project graph): for every
+project function, the set of PROJECT-DEFINED exception classes it may
+let escape — direct `raise X(...)`, bare `raise` inside a handler
+(re-raises the caught types), and propagation from resolved callees —
+computed to fixpoint, with `try/except` subtracting the types each
+handler catches (a handler catches a class, its project subclasses,
+and everything whose builtin ancestry it names; `except Exception` and
+bare `except` catch all). Builtin exceptions are OUT of scope: the
+vocabulary contract is about the typed errors this repo mints.
+Unresolved calls contribute nothing (fail-fewer-assumptions, like
+verdict-taint) — the dynamic seams this misses are pinned by the
+suite's error-path tests.
+
+A finding fires on a SEAM function whose escape set contains a type
+outside its documented vocabulary (subclasses of a documented type are
+fine — `SealRejected` IS-A `SealChainError`). The seam table below is
+the machine-readable copy of the docs' promises; updating a doc's
+error vocabulary means updating it here in the same PR.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from . import FileCtx, Finding
+
+_PKG = "cometbft_tpu"
+
+# seam (function/method/class qualname — a class means every public
+# method) -> documented escape vocabulary (project exception
+# qualnames). Source of truth: the docs cited per entry.
+SEAMS: Dict[str, FrozenSet[str]] = {
+    # docs/RPC_PARITY.md: every typed error is mapped to an RPCError
+    # -320xx before it crosses the JSON-RPC wire
+    f"{_PKG}.rpc.server.Routes": frozenset({
+        f"{_PKG}.rpc.server.RPCError"}),
+    # docs/MESH.md: shape refusal is MeshShapeError (defined in
+    # parallel/mesh.py, re-exported by mesh/topology.py), queue shed
+    # is MeshOverloaded — nothing else typed crosses the submit seam
+    f"{_PKG}.mesh.executor.MeshExecutor.submit": frozenset({
+        f"{_PKG}.mesh.executor.MeshOverloaded",
+        f"{_PKG}.parallel.mesh.MeshShapeError"}),
+    f"{_PKG}.mesh.topology.MeshTopology": frozenset({
+        f"{_PKG}.parallel.mesh.MeshShapeError"}),
+    # docs/STORAGE.md: unrepairable damage is a typed RecoveryError
+    # refusing boot
+    f"{_PKG}.store.recovery.run_doctor": frozenset({
+        f"{_PKG}.store.recovery.RecoveryError"}),
+    # docs/SEALSYNC.md: chain verification speaks SealChainError;
+    # the provider sheds with SealsyncOverloaded
+    f"{_PKG}.sealsync.chain.plan_adoption": frozenset({
+        f"{_PKG}.sealsync.chain.SealChainError"}),
+    f"{_PKG}.sealsync.chain.SealTuple.decode": frozenset({
+        f"{_PKG}.sealsync.chain.SealChainError"}),
+    f"{_PKG}.sealsync.provider.SealProvider": frozenset({
+        f"{_PKG}.sealsync.provider.SealsyncOverloaded",
+        f"{_PKG}.sealsync.chain.SealChainError"}),
+    # docs/SEALSYNC.md: adoption failure is AdoptionError (the caller
+    # logs and falls through to plain blocksync); seal rejection rides
+    # the SealChainError family
+    f"{_PKG}.sealsync.adopter.SealAdopter.adopt": frozenset({
+        f"{_PKG}.sealsync.adopter.AdoptionError",
+        f"{_PKG}.sealsync.chain.SealChainError"}),
+    # docs/INGEST.md: the admission queue sheds with IngestShed;
+    # a structurally-invalid envelope is MalformedTx (a ValueError —
+    # RPC maps it to -32603 with the other malformed shapes)
+    f"{_PKG}.ingest.admission.IngestPipeline.submit": frozenset({
+        f"{_PKG}.ingest.admission.IngestShed",
+        f"{_PKG}.ingest.tx.MalformedTx"}),
+    f"{_PKG}.ingest.admission.IngestPipeline.submit_nowait": frozenset({
+        f"{_PKG}.ingest.admission.IngestShed",
+        f"{_PKG}.ingest.tx.MalformedTx"}),
+}
+
+_CATCH_ALL = {"Exception", "BaseException"}
+
+
+class _Summary:
+    __slots__ = ("raises",)
+
+    def __init__(self):
+        self.raises: Set[str] = set()   # project exception qualnames
+
+
+class ExceptionContractRule:
+    name = "exception-contract"
+    doc = ("a project-typed exception escapes a documented public seam "
+           "outside its promised vocabulary — catch it and map it "
+           "(RPC: to an RPCError -320xx) per docs/STATICCHECK.md §v3")
+    roots: Tuple[str, ...] = (f"{_PKG}",)
+    exempt: frozenset = frozenset()
+    tree_rule = True
+    needs_project = True
+
+    def __init__(self):
+        self.used_pragmas: Set[Tuple[str, int, str]] = set()
+
+    def applies_to(self, path: str) -> bool:
+        if path in self.exempt:
+            return False
+        return any(path == top or path.startswith(top + "/")
+                   for top in self.roots)
+
+    def check(self, ctx: FileCtx):
+        return ()
+
+    # -- class facts -----------------------------------------------------
+
+    def _build_hierarchy(self, project) -> None:
+        """exception qualname -> its ancestor names, project qualnames
+        AND builtin base names mixed (for handler matching)."""
+        self._ancestors: Dict[str, Set[str]] = {}
+        self._exc_classes: Set[str] = set()
+        for qn, cls in project.classes.items():
+            anc: Set[str] = {qn}
+            stack = [qn]
+            seen = set()
+            while stack:
+                c = stack.pop()
+                if c in seen or c not in project.classes:
+                    continue
+                seen.add(c)
+                info = project.classes[c]
+                for b in info.bases:
+                    anc.add(b)
+                    stack.append(b)
+                for bnode in info.node.bases:
+                    if isinstance(bnode, ast.Name) \
+                            and f"{info.module}.{bnode.id}" \
+                            not in project.classes:
+                        anc.add(bnode.id)   # builtin (or unresolved)
+            self._ancestors[qn] = anc
+            if anc & {"Exception", "BaseException", "ValueError",
+                      "RuntimeError", "TypeError", "KeyError",
+                      "OSError", "ConnectionError", "IOError",
+                      "ArithmeticError", "LookupError"}:
+                self._exc_classes.add(qn)
+
+    def _resolve_class(self, project, func, node) -> Optional[str]:
+        qn = project._symbol_for_expr(node, func.path)
+        if qn in project.classes:
+            return qn
+        if isinstance(node, ast.Name):
+            local = f"{func.module}.{node.id}"
+            if local in project.classes:
+                return local
+        return None
+
+    def _handler_catches(self, project, func,
+                         handler: ast.ExceptHandler
+                         ) -> Tuple[Set[str], bool]:
+        """(builtin/base names this handler names, catches_all)."""
+        if handler.type is None:
+            return set(), True
+        nodes = handler.type.elts \
+            if isinstance(handler.type, ast.Tuple) else [handler.type]
+        names: Set[str] = set()
+        for n in nodes:
+            qn = self._resolve_class(project, func, n)
+            if qn is not None:
+                names.add(qn)
+                continue
+            if isinstance(n, ast.Name):
+                if n.id in _CATCH_ALL:
+                    return set(), True
+                names.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                names.add(n.attr)
+        return names, False
+
+    def _caught(self, raised: str, handler_names: Set[str]) -> bool:
+        return bool(self._ancestors.get(raised, {raised})
+                    & handler_names)
+
+    # -- per-function raise collection ------------------------------------
+
+    def _collect(self, project, func, summaries, targets) -> Set[str]:
+        out: Set[str] = set()
+
+        def handled(types: Set[str],
+                    stack: List[Tuple[Set[str], bool]]) -> Set[str]:
+            surv = set(types)
+            for names, all_ in stack:
+                if all_:
+                    return set()
+                surv = {t for t in surv
+                        if not self._caught(t, names)}
+            return surv
+
+        def calls_in(node, stack) -> None:
+            """Propagate resolved callees' escape sets for every call
+            under an EXPRESSION (never descends into nested defs)."""
+            if node is None:
+                return
+            for n in ast.walk(node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                if isinstance(n, ast.Call):
+                    for t in targets.get(id(n), ()):
+                        s = summaries.get(t)
+                        if s is not None:
+                            out.update(handled(set(s.raises), stack))
+
+        def walk(stmts, stack, caught_here: Set[str]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.Try):
+                    hspecs = [self._handler_catches(project, func, h)
+                              for h in stmt.handlers]
+                    inner = stack + hspecs
+                    walk(stmt.body, inner, caught_here)
+                    # a raise in `else` is not caught by this try's
+                    # handlers — only the outer stack applies
+                    walk(stmt.orelse, stack, caught_here)
+                    for h, (names, all_) in zip(stmt.handlers, hspecs):
+                        # types this arm may hold when a bare `raise`
+                        # re-raises: the project exceptions it names
+                        # (catch-all re-raise of an unresolved type is
+                        # out of model)
+                        held = {n for n in names
+                                if n in project.classes}
+                        walk(h.body, stack, held)
+                    walk(stmt.finalbody, stack, caught_here)
+                    continue
+                if isinstance(stmt, ast.Raise):
+                    if stmt.exc is None:
+                        out.update(handled(set(caught_here), stack))
+                    else:
+                        exc = stmt.exc
+                        target = exc.func \
+                            if isinstance(exc, ast.Call) else exc
+                        qn = self._resolve_class(project, func, target)
+                        if qn is not None and qn in self._exc_classes:
+                            out.update(handled({qn}, stack))
+                        elif isinstance(exc, ast.Name):
+                            # `raise e` of the handler's bound name
+                            out.update(handled(set(caught_here),
+                                               stack))
+                        calls_in(stmt.exc, stack)
+                        calls_in(stmt.cause, stack)
+                    continue
+                if isinstance(stmt, ast.If):
+                    calls_in(stmt.test, stack)
+                    walk(stmt.body, stack, caught_here)
+                    walk(stmt.orelse, stack, caught_here)
+                    continue
+                if isinstance(stmt, ast.While):
+                    calls_in(stmt.test, stack)
+                    walk(stmt.body, stack, caught_here)
+                    walk(stmt.orelse, stack, caught_here)
+                    continue
+                if isinstance(stmt, ast.For):
+                    calls_in(stmt.iter, stack)
+                    walk(stmt.body, stack, caught_here)
+                    walk(stmt.orelse, stack, caught_here)
+                    continue
+                if isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        calls_in(item.context_expr, stack)
+                    walk(stmt.body, stack, caught_here)
+                    continue
+                calls_in(stmt, stack)
+
+        walk(func.node.body, [], set())
+        return out
+
+    # -- driver -----------------------------------------------------------
+
+    def finalize(self, root: str, project=None) -> Iterator[Finding]:
+        if project is None:
+            return
+        from .lock_rules import _call_targets
+        self._build_hierarchy(project)
+        funcs = [f for f in project.functions.values()
+                 if self.applies_to(f.path)]
+        targets = {f.qualname: _call_targets(project, f)
+                   for f in funcs}
+        summaries: Dict[str, _Summary] = {f.qualname: _Summary()
+                                          for f in funcs}
+        for _ in range(len(funcs)):
+            changed = False
+            for f in funcs:
+                s = summaries[f.qualname]
+                got = self._collect(project, f, summaries,
+                                    targets[f.qualname])
+                if got - s.raises:
+                    s.raises |= got
+                    changed = True
+            if not changed:
+                break
+        findings: List[Finding] = []
+        for f in funcs:
+            allowed = self._allowed_for(f)
+            if allowed is None:
+                continue
+            allowed_closure = {q for q in self._exc_classes
+                               if self._ancestors.get(q, set())
+                               & allowed} | allowed
+            escaping = summaries[f.qualname].raises - allowed_closure
+            if not escaping:
+                continue
+            ctx = project.ctxs.get(f.path)
+            names = ", ".join(sorted(q.rsplit(".", 1)[-1]
+                                     for q in escaping))
+            findings.append(ctx.finding(
+                self.name, f.node,
+                f"{f.qualname.rsplit('.', 2)[-2]}."
+                f"{f.name}() lets undocumented typed error(s) "
+                f"escape: {names} — the documented vocabulary here "
+                f"is {{{', '.join(sorted(a.rsplit('.', 1)[-1] for a in self._allowed_for(f)))}}}; "
+                f"catch and map (or extend the docs AND the seam "
+                f"table together)"))
+        for fnd in sorted(findings,
+                          key=lambda x: (x.path, x.line, x.message)):
+            yield fnd
+
+    def _allowed_for(self, func) -> Optional[FrozenSet[str]]:
+        got = SEAMS.get(func.qualname)
+        if got is not None:
+            return got
+        if func.cls is not None and func.cls in SEAMS \
+                and not func.name.startswith("_"):
+            return SEAMS[func.cls]
+        return None

@@ -1,0 +1,404 @@
+"""resource-lifecycle — futures, locks, and file handles must reach
+their terminal operation on every path.
+
+Three obligations, one rule (docs/STATICCHECK.md §v3):
+
+FUTURE DRAIN (path-sensitive, intraprocedural). A `*Future` produced
+by a `.submit(...)` call or a `SomethingFuture(...)` constructor is an
+obligation: on every exit path out of the producing function the bound
+name must have been USED — returned, enqueued, stored, completed
+(`set_result`/`set_exception`/`cancel`/`result`), or handed to another
+call (the watchdog / cpu_drain seams are ordinary argument sinks here).
+The analysis walks the function body with an abstract "live
+undischarged futures" set; `except` arms restart from the state at
+`try` entry because any statement of the body — including the one that
+would have discharged the future — may not have run. A `raise` or
+`return` while an obligation is live is the finding. This is exactly
+the `MeshExecutor.submit()` queue-full shape: the future exists, the
+enqueue failed, and the error path walks away from it.
+
+SHUTDOWN DRAIN (class-structural). A class whose `submit()` enqueues
+its futures into a `self.<q>` queue owns every future in that queue:
+its `close()` must fail or drain the queued-but-undispatched items
+(`get_nowait` loop + `set_exception`/`cancel`) — otherwise a caller
+blocked in `result()` with no timeout hangs on work that will never
+run. Flagged when `close()` never touches the queue attribute with a
+draining operation.
+
+LOCK DISCIPLINE (lexical). `.acquire()` on a lock-named receiver
+(`*lock*`, `*mutex*`, `_lk`) must sit inside a `try` whose `finally`
+releases the same receiver, or be replaced by `with`. Deliberate
+exported lock()/unlock() pair seams carry an allow() pragma with the
+justification inline.
+
+RAW open() (lexical). Builtin `open()` / `os.fdopen()` outside a
+`with` item leaks the descriptor on any exception between open and
+close. `libs/faultio.py` is the sanctioned seam (it IS the managed
+wrapper); the crash-consistent trees are already forced through it by
+raw-file-io.
+
+Everything here is best-effort over `ast` and tuned to fail safe for
+its question: an unresolved call target counts as a USE of its
+argument futures (fewer false leaks), and only name-bound futures are
+tracked (an expression-statement `.submit(...)` whose result is
+dropped on the floor is flagged directly).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from . import FileCtx, Finding
+
+_FUTURE_METHODS = {"result", "cancel", "exception", "set_result",
+                   "set_exception", "add_done_callback"}
+_DRAIN_OPS = {"get_nowait", "set_exception", "cancel", "join_and_fail"}
+_LOCK_HINTS = ("lock", "mutex", "_lk")
+
+# the managed-file seam itself opens raw by design
+_OPEN_EXEMPT_PATHS = ("cometbft_tpu/libs/faultio.py",)
+
+
+def _recv_text(node: ast.AST) -> Optional[str]:
+    """Dotted receiver text for `a.b.c` shapes, None for anything
+    dynamic."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _recv_text(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _own_statements(root: ast.AST):
+    """Statement walk that never descends into nested defs/lambdas —
+    a closure's obligations belong to whoever calls it."""
+    for node in ast.iter_child_nodes(root):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        yield from _own_statements(node)
+
+
+class _FutureLeakScan:
+    """One function body: track names bound to fresh futures and flag
+    exit paths that abandon them."""
+
+    def __init__(self, rule, ctx: FileCtx, func, project, emit):
+        self.rule = rule
+        self.ctx = ctx
+        self.func = func
+        self.project = project
+        self.emit = emit
+        self.binds: Dict[str, int] = {}  # name -> binding line
+
+    # -- producer / use classification ----------------------------------
+
+    def _is_future_call(self, call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "submit":
+            return True
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        return bool(name) and name.endswith("Future")
+
+    def _uses(self, node: ast.AST) -> Set[str]:
+        """Names loaded anywhere under `node` (nested defs included —
+        capturing a future in a closure is a handoff)."""
+        out: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+        return out
+
+    # -- abstract execution ---------------------------------------------
+
+    def run(self) -> None:
+        self.exec_block(self.func.node.body, set())
+
+    def _leak(self, node: ast.AST, live: Set[str], why: str) -> None:
+        for name in sorted(live):
+            self.emit(self.ctx.finding(
+                self.rule.name, node,
+                f"future '{name}' (bound line {self.binds[name]}) is "
+                f"abandoned on this {why} path — complete it "
+                f"(set_exception/cancel) or hand it off before "
+                f"leaving; a caller blocked in result() would hang"))
+
+    def exec_block(self, body: List[ast.stmt],
+                   live: Set[str]) -> Tuple[Set[str], bool]:
+        """Returns (live set at fall-through, reachable) — reachable
+        False when every path already exited."""
+        for stmt in body:
+            live, reachable = self.exec_stmt(stmt, live)
+            if not reachable:
+                return live, False
+        return live, True
+
+    def exec_stmt(self, stmt: ast.stmt,
+                  live: Set[str]) -> Tuple[Set[str], bool]:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Call) \
+                and self._is_future_call(stmt.value):
+            live = live - self._uses(stmt.value)
+            name = stmt.targets[0].id
+            self.binds[name] = stmt.lineno
+            return live | {name}, True
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call) \
+                and self._is_future_call(stmt.value) \
+                and isinstance(stmt.value.func, ast.Attribute) \
+                and stmt.value.func.attr == "submit":
+            # discarded submit: the future is born un-owned
+            self.emit(self.ctx.finding(
+                self.rule.name, stmt,
+                "submit() result discarded — the returned future is "
+                "the only handle to this dispatch; bind and drain it "
+                "(or use the blocking verify seam)"))
+            return live - self._uses(stmt), True
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            remaining = live - self._uses(stmt)
+            if remaining:
+                self._leak(stmt, remaining,
+                           "raise" if isinstance(stmt, ast.Raise)
+                           else "return")
+            return set(), False
+        if isinstance(stmt, ast.If):
+            after_test = live - self._uses(stmt.test)
+            l1, r1 = self.exec_block(stmt.body, set(after_test))
+            l2, r2 = self.exec_block(stmt.orelse, set(after_test))
+            if not (r1 or r2):
+                return set(), False
+            return ((l1 if r1 else set()) | (l2 if r2 else set()),
+                    True)
+        if isinstance(stmt, (ast.While, ast.For)):
+            head = (stmt.test if isinstance(stmt, ast.While)
+                    else stmt.iter)
+            live = live - self._uses(head)
+            l1, _ = self.exec_block(stmt.body, set(live))
+            # may-leak join: zero iterations keeps `live`, one-or-more
+            # ends at l1 (which may have minted new obligations)
+            after = live | l1
+            l2, r2 = self.exec_block(stmt.orelse, set(after))
+            return (l2 if r2 else after), True
+        if isinstance(stmt, ast.Try):
+            entry = set(live)
+            lb, rb = self.exec_block(stmt.body, set(live))
+            outs: List[Set[str]] = []
+            any_reach = False
+            if rb:
+                le, re_ = self.exec_block(stmt.orelse, set(lb))
+                if re_:
+                    outs.append(le)
+                    any_reach = True
+            for h in stmt.handlers:
+                # the body may have failed BEFORE the discharging use
+                # ran: the handler path owes everything owed at entry
+                lh, rh = self.exec_block(h.body, set(entry))
+                if rh:
+                    outs.append(lh)
+                    any_reach = True
+            if stmt.finalbody:
+                merged: Set[str] = set()
+                for o in outs:
+                    merged |= o
+                if not outs:
+                    merged = entry
+                lf, rf = self.exec_block(stmt.finalbody, merged)
+                if not rf:
+                    return set(), False
+                outs = [lf & o for o in outs] if outs else [lf]
+            if not any_reach:
+                # finally ran (or there was none) but every arm exited
+                return set(), False
+            out: Set[str] = set()
+            for o in outs:
+                out |= o
+            return out, True
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                live = live - self._uses(item.context_expr)
+            return self.exec_block(stmt.body, live)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # capturing a future inside a nested def is a handoff
+            return live - self._uses(stmt), True
+        # generic statement: any mention is a use/handoff
+        return live - self._uses(stmt), True
+
+
+class ResourceLifecycleRule:
+    name = "resource-lifecycle"
+    doc = ("a future from submit() abandoned on an exit path, a "
+           "submit-queue close() that never fails queued futures, a "
+           "lock.acquire() without with/try-finally release(), or a "
+           "raw open() outside a context manager "
+           "(docs/STATICCHECK.md §v3)")
+    roots: Tuple[str, ...] = ("cometbft_tpu",)
+    exempt: frozenset = frozenset()
+    tree_rule = True
+    needs_project = True
+
+    def __init__(self):
+        self.used_pragmas: Set[Tuple[str, int, str]] = set()
+
+    def applies_to(self, path: str) -> bool:
+        if path in self.exempt:
+            return False
+        return any(path == top or path.startswith(top + "/")
+                   for top in self.roots)
+
+    def check(self, ctx: FileCtx):
+        return ()
+
+    def finalize(self, root: str, project=None) -> Iterator[Finding]:
+        if project is None:
+            return
+        findings: List[Finding] = []
+        for f in project.functions.values():
+            if not self.applies_to(f.path):
+                continue
+            ctx = project.ctxs.get(f.path)
+            if ctx is None:
+                continue
+            _FutureLeakScan(self, ctx, f, project,
+                            findings.append).run()
+            self._scan_locks(ctx, f, findings.append)
+            self._scan_opens(ctx, f, findings.append)
+        for cls in project.classes.values():
+            if self.applies_to(cls.path):
+                self._scan_shutdown(project, cls, findings.append)
+        seen = set()
+        for fnd in sorted(findings,
+                          key=lambda x: (x.path, x.line, x.message)):
+            key = (fnd.path, fnd.line, fnd.message)
+            if key not in seen:
+                seen.add(key)
+                yield fnd
+
+    # -- shutdown drain --------------------------------------------------
+
+    def _future_queue_attrs(self, cls) -> Set[str]:
+        """self.<attr> queues that submit() feeds futures into."""
+        out: Set[str] = set()
+        submit = cls.methods.get("submit")
+        if submit is None:
+            return out
+        fut_names: Set[str] = set()
+        for node in ast.walk(submit.node):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                fn = node.value.func
+                nm = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if nm.endswith("Future"):
+                    fut_names.add(node.targets[0].id)
+        if not fut_names:
+            return out
+        for node in ast.walk(submit.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("put", "put_nowait")):
+                continue
+            recv = node.func.value
+            if not (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"):
+                continue
+            payload_names = {n.id for a in node.args
+                             for n in ast.walk(a)
+                             if isinstance(n, ast.Name)}
+            if payload_names & fut_names:
+                out.add(recv.attr)
+        return out
+
+    def _scan_shutdown(self, project, cls, emit) -> None:
+        qattrs = self._future_queue_attrs(cls)
+        if not qattrs:
+            return
+        close = cls.methods.get("close") or cls.methods.get("stop")
+        anchor = (close or cls.methods["submit"]).node
+        ctx = project.ctxs[cls.path]
+        drained: Set[str] = set()
+        if close is not None:
+            ops = {n.attr for n in ast.walk(close.node)
+                   if isinstance(n, ast.Attribute)}
+            if ops & _DRAIN_OPS:
+                attrs = {n.attr for n in ast.walk(close.node)
+                         if isinstance(n, ast.Attribute)
+                         and isinstance(n.value, ast.Name)
+                         and n.value.id == "self"}
+                drained = attrs & qattrs
+        for attr in sorted(qattrs - drained):
+            emit(ctx.finding(
+                self.name, anchor,
+                f"{cls.name}.submit() enqueues futures into "
+                f"self.{attr} but "
+                f"{'close()' if close else 'no close()/stop()'} "
+                f"never fails the queued-but-undispatched items — "
+                f"drain with get_nowait() + set_exception so no "
+                f"caller hangs in result() on work that will never "
+                f"run"))
+
+    # -- lock discipline -------------------------------------------------
+
+    def _scan_locks(self, ctx: FileCtx, func, emit) -> None:
+        protected: Set[str] = set()
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Try) and node.finalbody:
+                for n in ast.walk(ast.Module(node.finalbody, [])):
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "release":
+                        recv = _recv_text(n.func.value)
+                        if recv:
+                            protected.add(recv)
+        for node in _own_statements(func.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                continue
+            recv = _recv_text(node.func.value)
+            if recv is None or recv in protected:
+                continue
+            low = recv.lower()
+            if not any(h in low for h in _LOCK_HINTS):
+                continue
+            emit(ctx.finding(
+                self.name, node,
+                f"{recv}.acquire() without a try/finally "
+                f"{recv}.release() — an exception between acquire "
+                f"and release wedges every other waiter; use `with "
+                f"{recv}:` or pair it in a finally"))
+
+    # -- raw open --------------------------------------------------------
+
+    def _scan_opens(self, ctx: FileCtx, func, emit) -> None:
+        if ctx.path in _OPEN_EXEMPT_PATHS:
+            return
+        with_items: Set[int] = set()
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    for n in ast.walk(item.context_expr):
+                        with_items.add(id(n))
+        for node in ast.walk(func.node):
+            if not isinstance(node, ast.Call) or id(node) in with_items:
+                continue
+            fn = node.func
+            is_open = (isinstance(fn, ast.Name) and fn.id == "open") \
+                or (isinstance(fn, ast.Attribute)
+                    and fn.attr == "fdopen"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "os")
+            if is_open:
+                emit(ctx.finding(
+                    self.name, node,
+                    "open() outside a context manager leaks the "
+                    "descriptor on any exception before close() — "
+                    "use `with open(...)` (libs/faultio is the "
+                    "managed seam for the crash-consistent trees)"))

@@ -251,6 +251,9 @@ class ReactorSleepRule(Rule):
 from .kernel_rules import KernelDisciplineRule  # noqa: E402
 from .lock_rules import GuardedByRule, LockOrderRule  # noqa: E402
 from .taint import VerdictTaintRule  # noqa: E402
+from .interval_rules import KernelIntervalRule  # noqa: E402
+from .lifecycle_rules import ResourceLifecycleRule  # noqa: E402
+from .contract_rules import ExceptionContractRule  # noqa: E402
 
 
 class FailPointRule(Rule):
@@ -429,4 +432,5 @@ class MetricsDriftRule(Rule):
 ALL_RULES = [WallClockRule, GlobalRngRule, RawEnvRule, ReactorSleepRule,
              GuardedByRule, FailPointRule, BareExceptRule,
              MetricsDriftRule, LockOrderRule, VerdictTaintRule,
-             KernelDisciplineRule, RawFileIoRule]
+             KernelDisciplineRule, RawFileIoRule, KernelIntervalRule,
+             ResourceLifecycleRule, ExceptionContractRule]
